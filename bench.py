@@ -1,0 +1,76 @@
+"""Benchmark: greedy decode throughput on the real chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Model: a Llama-3.2-3B-class config — the model family the reference's
+anecdotal anchor was measured on (~4 tok/s on the author's edge node at
+max_new_tokens=1024, `/root/reference/start_node.py:20` comment; BASELINE.md
+"anecdotal runtime anchor"). vs_baseline is decode tok/s divided by that
+4 tok/s anchor — the only number the reference world provides.
+
+Weights are random (throughput is weight-value independent); bf16; full model
+on one chip; decode runs inside one compiled while_loop program via
+runtime.generate.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from llm_sharding_tpu.models import llama
+    from llm_sharding_tpu.models.config import llama32_3b
+    from llm_sharding_tpu.runtime.generate import generate
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+
+    if on_tpu:
+        cfg = llama32_3b()
+        prompt_len, max_new = 32, 256
+    else:  # CPU fallback so the bench is runnable anywhere
+        from llm_sharding_tpu.models.config import tiny_llama
+
+        cfg = tiny_llama()
+        prompt_len, max_new = 8, 32
+
+    params = llama.init_params(cfg, jax.random.key(0), dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, (1, prompt_len)).astype(np.int32)
+
+    # Warm-up / compile (the discipline the reference profiler applies at
+    # /root/reference/utils/node_profiler.py:860-878). Must use the SAME
+    # static args (max_new_tokens, capacity) as the timed run — a different
+    # max_new is a different compiled program and the timing would include
+    # compilation.
+    generate(cfg, params, prompt, max_new, capacity=prompt_len + max_new)
+
+    t0 = time.perf_counter()
+    res = generate(cfg, params, prompt, max_new, capacity=prompt_len + max_new)
+    elapsed = time.perf_counter() - t0
+
+    generated = int(res.lengths[0]) - prompt_len
+    tok_s = generated / elapsed
+
+    print(
+        json.dumps(
+            {
+                "metric": "decode_tok_s_llama3.2-3b_1chip" if on_tpu else "decode_tok_s_tiny_cpu",
+                "value": round(tok_s, 2),
+                "unit": "tokens/sec",
+                "vs_baseline": round(tok_s / 4.0, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    main()

@@ -1,0 +1,24 @@
+"""llm_sharding_tpu — TPU-native model-chain inference framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the reference
+"llm-sharding" edge-device pipeline (model sharding into per-layer stores,
+multi-device layer-pipeline autoregressive decoding, placement control plane,
+capability profiling), built TPU-first: pjit/shard_map over device meshes,
+``lax.ppermute`` over ICI instead of ZMQ-over-TCP, ``lax.while_loop`` decode
+instead of Python spin loops, pytree shard stores instead of torch pickles.
+
+Public surface:
+    models.config      -- ModelConfig + presets (llama2/3/3.2, gpt2)
+    models.llama/gpt2  -- pure-JAX model cores
+    models.cache       -- jit-stable KV cache
+    utils.convert      -- HF checkpoint -> pytree conversion
+    utils.shard_store  -- offline sharding + role-conditional stage loading
+    parallel.placement -- layer-range -> mesh placement (control plane)
+    parallel.mesh      -- mesh construction helpers
+    parallel.pipeline  -- shard_map/ppermute pipeline generation
+    runtime.generate   -- single-host generation (oracle + serving core)
+"""
+
+from . import models, ops, parallel, runtime, utils  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from . import cache, config, gpt2, llama, stack  # noqa: F401

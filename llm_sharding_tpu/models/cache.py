@@ -1,0 +1,78 @@
+"""Preallocated, jit-stable KV cache.
+
+The reference uses HF ``DynamicCache`` — one per node, growing unboundedly with
+each decode step (``/root/reference/utils/node_worker.py:184, 253-258``).
+Unbounded growth would force an XLA recompile every step; instead the cache is
+a fixed-capacity ring of arrays plus a scalar length, updated functionally with
+``lax.dynamic_update_slice`` so the whole decode loop stays inside one compiled
+program (SURVEY.md §7 "KV cache shape discipline under jit").
+
+Layout: ``k, v: [num_layers, batch, capacity, num_kv_heads, head_dim]`` plus
+``pos: [batch, capacity]`` — the absolute token position of each slot's key,
+initialized to a large sentinel. Attention masks on ``pos <= query_position``,
+so uninitialized slots and padded prompt tokens (written with the sentinel)
+are excluded automatically; this is what makes right-padded batched decode
+correct — a capability the reference (batch=1 only) never needed. ``length``
+is only the shared write offset. ``clear()`` gives the semantics of the
+reference's clear-KV-cache ring protocol (``utils/node_worker.py:319-355``)
+without reallocating.
+
+Capacity contract: writes beyond ``capacity`` cannot raise inside jit (XLA
+clamps dynamic-slice starts), so callers must guarantee
+``prompt_len + max_new_tokens <= capacity`` at the host boundary — the decode
+APIs in ``runtime/`` validate this before tracing.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .config import ModelConfig
+
+
+POS_SENTINEL = jnp.int32(2**30)  # "no key here" — larger than any real position
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # [L, B, C, Hkv, D]
+    v: jax.Array  # [L, B, C, Hkv, D]
+    pos: jax.Array  # [B, C] int32 — absolute position of each key, or sentinel
+    length: jax.Array  # scalar int32 — shared write offset
+
+    @property
+    def capacity(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+
+def init_cache(
+    cfg: ModelConfig,
+    batch_size: int,
+    capacity: int,
+    num_layers: int | None = None,
+    dtype=jnp.bfloat16,
+) -> KVCache:
+    """Allocate an empty cache for ``num_layers`` (a pipeline stage's slice)."""
+    L = cfg.num_hidden_layers if num_layers is None else num_layers
+    shape = (L, batch_size, capacity, cfg.num_key_value_heads, cfg.head_dim_)
+    return KVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        pos=jnp.full((batch_size, capacity), POS_SENTINEL, jnp.int32),
+        length=jnp.zeros((), jnp.int32),
+    )
+
+
+def clear(cache: KVCache) -> KVCache:
+    """Reset without reallocating (≙ reference ``clear_KV_cache``,
+    ``/root/reference/utils/node_worker.py:319-355``)."""
+    return cache._replace(
+        pos=jnp.full_like(cache.pos, POS_SENTINEL),
+        length=jnp.zeros((), jnp.int32),
+    )

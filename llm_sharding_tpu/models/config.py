@@ -1,0 +1,249 @@
+"""Model configuration for the TPU-native model-chain framework.
+
+The reference derives its model structure from HF ``config.json`` files copied
+into each shard directory (``/root/reference/utils/model_sharder.py:50-61``,
+``utils/shard_loader.py:35``) and supports two architectures: "llama" and "gpt"
+(``utils/model_sharder.py:64-132``). Here the same information lives in one
+explicit dataclass that is serialized into the shard store and used to build
+pure-JAX forward functions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3 style RoPE frequency scaling (``rope_type="llama3"``)."""
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8192
+    rope_type: str = "llama3"
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Architecture hyper-parameters for a causal LM.
+
+    ``model_type`` selects the block structure the same way the reference's
+    ``ModelSharder`` branches on "llama" vs "gpt"
+    (``/root/reference/utils/model_sharder.py:64,96``).
+    """
+
+    model_type: str = "llama"  # "llama" | "gpt2"
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    head_dim: Optional[int] = None
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    rope_scaling: Optional[RopeScaling] = None
+    tie_word_embeddings: bool = False
+    attention_bias: bool = False
+    mlp_bias: bool = False
+    # GPT-2 specifics
+    layer_norm_epsilon: float = 1e-5
+    # Token ids. ``eos_token_ids`` holds ALL stop ids (Llama-3.x instruct
+    # models ship several, e.g. <|end_of_text|> and <|eot_id|>); decode loops
+    # must stop on any of them. ``eos_token_id`` is the primary/first one.
+    bos_token_id: int = 1
+    eos_token_id: int = 2
+    eos_token_ids: tuple = ()
+
+    def __post_init__(self):
+        if not self.eos_token_ids:
+            object.__setattr__(self, "eos_token_ids", (self.eos_token_id,))
+        else:
+            object.__setattr__(self, "eos_token_ids", tuple(self.eos_token_ids))
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_attention_heads
+
+    @property
+    def num_kv_groups(self) -> int:
+        return self.num_attention_heads // self.num_key_value_heads
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        return json.dumps(d, indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "ModelConfig":
+        d = json.loads(text)
+        if d.get("rope_scaling") is not None:
+            d["rope_scaling"] = RopeScaling(**d["rope_scaling"])
+        return cls(**d)
+
+    @classmethod
+    def from_hf_config(cls, hf: dict[str, Any]) -> "ModelConfig":
+        """Build from a HuggingFace ``config.json`` dict (llama or gpt2)."""
+        mt = hf.get("model_type", "llama")
+        if mt in ("llama",):
+            rs = None
+            raw_rs = hf.get("rope_scaling")
+            if raw_rs:
+                rt = raw_rs.get("rope_type", raw_rs.get("type"))
+                if rt == "llama3":
+                    rs = RopeScaling(
+                        factor=raw_rs.get("factor", 8.0),
+                        low_freq_factor=raw_rs.get("low_freq_factor", 1.0),
+                        high_freq_factor=raw_rs.get("high_freq_factor", 4.0),
+                        original_max_position_embeddings=raw_rs.get(
+                            "original_max_position_embeddings", 8192
+                        ),
+                    )
+                elif rt in ("default", None):
+                    rs = None
+                else:
+                    raise ValueError(
+                        f"unsupported rope_scaling type {rt!r}; only 'llama3' "
+                        "and default RoPE are implemented"
+                    )
+            eos = hf.get("eos_token_id", 2)
+            eos_ids = tuple(eos) if isinstance(eos, list) else (eos,)
+            return cls(
+                model_type="llama",
+                vocab_size=hf["vocab_size"],
+                hidden_size=hf["hidden_size"],
+                intermediate_size=hf["intermediate_size"],
+                num_hidden_layers=hf["num_hidden_layers"],
+                num_attention_heads=hf["num_attention_heads"],
+                num_key_value_heads=hf.get(
+                    "num_key_value_heads", hf["num_attention_heads"]
+                ),
+                head_dim=hf.get("head_dim"),
+                max_position_embeddings=hf.get("max_position_embeddings", 4096),
+                rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+                rope_theta=hf.get("rope_theta", 10000.0),
+                rope_scaling=rs,
+                tie_word_embeddings=hf.get("tie_word_embeddings", False),
+                attention_bias=hf.get("attention_bias", False),
+                mlp_bias=hf.get("mlp_bias", False),
+                bos_token_id=hf.get("bos_token_id", 1),
+                eos_token_id=eos_ids[0],
+                eos_token_ids=eos_ids,
+            )
+        elif mt == "gpt2":
+            n_embd = hf.get("n_embd", 768)
+            return cls(
+                model_type="gpt2",
+                vocab_size=hf.get("vocab_size", 50257),
+                hidden_size=n_embd,
+                intermediate_size=hf.get("n_inner") or 4 * n_embd,
+                num_hidden_layers=hf.get("n_layer", 12),
+                num_attention_heads=hf.get("n_head", 12),
+                num_key_value_heads=hf.get("n_head", 12),
+                max_position_embeddings=hf.get("n_positions", 1024),
+                layer_norm_epsilon=hf.get("layer_norm_epsilon", 1e-5),
+                tie_word_embeddings=True,
+                bos_token_id=hf.get("bos_token_id", 50256),
+                eos_token_id=hf.get("eos_token_id", 50256),
+            )
+        raise ValueError(f"unsupported model_type: {mt!r}")
+
+
+# Convenience presets (sizes mirror the models the reference targets:
+# Llama-2-7B / Llama-3.2-3B / GPT-2, /root/reference/README.md + model_sharder.py)
+def llama2_7b() -> ModelConfig:
+    return ModelConfig()
+
+
+def llama2_13b() -> ModelConfig:
+    return ModelConfig(
+        hidden_size=5120,
+        intermediate_size=13824,
+        num_hidden_layers=40,
+        num_attention_heads=40,
+        num_key_value_heads=40,
+    )
+
+
+def llama3_8b() -> ModelConfig:
+    return ModelConfig(
+        vocab_size=128256,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_hidden_layers=32,
+        num_attention_heads=32,
+        num_key_value_heads=8,
+        max_position_embeddings=8192,
+        rope_theta=500000.0,
+        rope_scaling=RopeScaling(),
+        bos_token_id=128000,
+        eos_token_id=128001,
+    )
+
+
+def llama32_3b() -> ModelConfig:
+    return ModelConfig(
+        vocab_size=128256,
+        hidden_size=3072,
+        intermediate_size=8192,
+        num_hidden_layers=28,
+        num_attention_heads=24,
+        num_key_value_heads=8,
+        head_dim=128,
+        max_position_embeddings=8192,
+        rope_theta=500000.0,
+        rope_scaling=RopeScaling(factor=32.0),
+        tie_word_embeddings=True,
+        bos_token_id=128000,
+        eos_token_id=128001,
+    )
+
+
+def llama2_70b() -> ModelConfig:
+    return ModelConfig(
+        hidden_size=8192,
+        intermediate_size=28672,
+        num_hidden_layers=80,
+        num_attention_heads=64,
+        num_key_value_heads=8,
+    )
+
+
+def gpt2_small() -> ModelConfig:
+    return ModelConfig.from_hf_config({"model_type": "gpt2"})
+
+
+def tiny_llama(**kw) -> ModelConfig:
+    """Tiny config for CPU tests (the reference has no tests; SURVEY.md §4)."""
+    base = dict(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+    )
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+def tiny_gpt2(**kw) -> ModelConfig:
+    base = dict(
+        model_type="gpt2",
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=256,
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        num_key_value_heads=4,
+        max_position_embeddings=128,
+        tie_word_embeddings=True,
+        bos_token_id=0,
+        eos_token_id=0,
+    )
+    base.update(kw)
+    return ModelConfig(**base)

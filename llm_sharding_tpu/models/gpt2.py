@@ -1,0 +1,101 @@
+"""Pure-JAX GPT-2 causal LM — the reference's second architecture.
+
+The reference's ``ModelSharder`` has a "gpt" branch that bundles wte+wpe into
+``embedding.pth``, each ``h.{i}`` block into ``block_{i}.pth``, ``ln_f.pth``
+and a wte-tied ``lm_head.pth`` (``/root/reference/utils/model_sharder.py:
+96-132``). This module is the runtime consumer of that split in pytree form,
+with the same stage interface as ``models/llama.py`` (scan over stacked layer
+params, explicit KV cache, ragged-stage ``layer_mask``) so the pipeline
+runtime is architecture-agnostic.
+
+HF GPT-2 notes: Conv1D weights are stored ``[in, out]`` (no transpose on
+conversion), attention/MLP have biases, activations are gelu_new (tanh
+approximation), positions come from a learned ``wpe`` table added at embed
+time — so unlike Llama there is nothing positional inside the layers, and the
+reference's cos/sin-shipping problem never arises.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import cached_attention
+from ..ops.norms import layer_norm
+from .cache import KVCache
+from .config import ModelConfig
+from .stack import scan_layers
+
+Params = dict[str, Any]
+
+
+def embed(params: Params, token_ids: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
+    """wte[ids] + wpe[positions] (≙ the reference's bundled GPT embedding,
+    ``/root/reference/utils/model_sharder.py:100-108``)."""
+    return params["embed"][token_ids] + params["pos_embed"][positions]
+
+
+def decoder_layer(
+    cfg: ModelConfig,
+    p: Params,
+    h: jnp.ndarray,  # [B, S, H]
+    k_row: jnp.ndarray,  # [B, C, Nh, D]
+    v_row: jnp.ndarray,
+    positions: jnp.ndarray,  # [B, S]
+    kv_positions: jnp.ndarray,  # [B, C]
+    length: jnp.ndarray,
+):
+    B, S, H = h.shape
+    Nh = cfg.num_attention_heads
+    D = cfg.head_dim_
+
+    x = layer_norm(h, p["ln1_w"], p["ln1_b"], cfg.layer_norm_epsilon)
+    qkv = x @ p["w_qkv"] + p["b_qkv"]  # [B, S, 3H]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, Nh, D)
+    k = k.reshape(B, S, Nh, D)
+    v = v.reshape(B, S, Nh, D)
+
+    k_row = jax.lax.dynamic_update_slice(k_row, k.astype(k_row.dtype), (0, length, 0, 0))
+    v_row = jax.lax.dynamic_update_slice(v_row, v.astype(v_row.dtype), (0, length, 0, 0))
+
+    attn = cached_attention(q, k_row, v_row, positions, kv_positions)
+    h = h + attn.reshape(B, S, H) @ p["w_proj"] + p["b_proj"]
+
+    x = layer_norm(h, p["ln2_w"], p["ln2_b"], cfg.layer_norm_epsilon)
+    mlp = jax.nn.gelu((x @ p["w_fc"] + p["b_fc"]).astype(jnp.float32), approximate=True)
+    h = h + mlp.astype(x.dtype) @ p["w_out"] + p["b_out"]
+    return h, k_row, v_row
+
+
+def forward_layers(
+    cfg: ModelConfig,
+    layers: Params,
+    h: jnp.ndarray,
+    cache: KVCache,
+    positions: jnp.ndarray,
+    layer_mask: Optional[jnp.ndarray] = None,
+) -> tuple[jnp.ndarray, KVCache]:
+    def apply(p, h, k_row, v_row, kv_pos, length):
+        return decoder_layer(cfg, p, h, k_row, v_row, positions, kv_pos, length)
+
+    return scan_layers(layers, h, cache, positions, apply, layer_mask)
+
+
+def final_logits(cfg: ModelConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
+    h = layer_norm(h, params["final_norm"], params["final_norm_bias"], cfg.layer_norm_epsilon)
+    return (h @ params["lm_head"]).astype(jnp.float32)
+
+
+def forward(
+    cfg: ModelConfig,
+    params: Params,
+    token_ids: jnp.ndarray,
+    cache: KVCache,
+    positions: jnp.ndarray,
+) -> tuple[jnp.ndarray, KVCache]:
+    h = embed(params, token_ids, positions)
+    h, cache = forward_layers(cfg, params["layers"], h, cache, positions)
+    return final_logits(cfg, params, h), cache

@@ -1,0 +1,178 @@
+"""Pure-JAX Llama-family causal LM (Llama-2 / Llama-3 / Llama-3.2, GQA).
+
+Replaces the reference's use of HF ``LlamaDecoderLayer`` / ``LlamaRMSNorm``
+modules (``/root/reference/utils/shard_loader.py:5, 36-55``) with functional
+blocks over explicit parameter pytrees. A stage's layer stack is a ``lax.scan``
+over layer-stacked parameters — one compiled loop body regardless of how many
+layers a pipeline stage holds — with an optional per-layer validity mask so
+ragged layer splits (e.g. the reference's 6/1/25 split in
+``/root/reference/send_config.py:10-34``) run under one SPMD program.
+
+Parameter pytree (all leaves ``jnp`` arrays):
+
+``params = {"embed": [V,H], "layers": {...each leaf stacked [L, ...]},
+"final_norm": [H], "lm_head": [H,V]}``
+
+This mirrors the reference's shard-store split — ``embedding.pth`` /
+``block_{i}.pth`` / ``final_norm.pth`` / ``lm_head.pth``
+(``/root/reference/utils/model_sharder.py:64-94``) — as pytree keys.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import cached_attention
+from ..ops.norms import rms_norm
+from ..ops.rope import apply_rope, rope_cos_sin
+from .cache import KVCache
+from .config import ModelConfig
+from .stack import scan_layers
+
+Params = dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Initialization (random weights for tests/benchmarks; real weights come from
+# the checkpoint converter in utils/convert.py)
+# ---------------------------------------------------------------------------
+
+def init_layer_params(
+    cfg: ModelConfig, key: jax.Array, num_layers: int, dtype=jnp.bfloat16
+) -> Params:
+    H, I = cfg.hidden_size, cfg.intermediate_size
+    D = cfg.head_dim_
+    Nh, Nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+    ks = jax.random.split(key, 7)
+    L = num_layers
+
+    def w(k, *shape):
+        fan_in = shape[-2]
+        return (jax.random.normal(k, (L, *shape), jnp.float32) * fan_in**-0.5).astype(
+            dtype
+        )
+
+    return {
+        "input_norm": jnp.ones((L, H), dtype),
+        "wq": w(ks[0], H, Nh * D),
+        "wk": w(ks[1], H, Nkv * D),
+        "wv": w(ks[2], H, Nkv * D),
+        "wo": w(ks[3], Nh * D, H),
+        "post_norm": jnp.ones((L, H), dtype),
+        "w_gate": w(ks[4], H, I),
+        "w_up": w(ks[5], H, I),
+        "w_down": w(ks[6], I, H),
+    }
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    k_emb, k_layers, k_head = jax.random.split(key, 3)
+    V, H = cfg.vocab_size, cfg.hidden_size
+    embed = (jax.random.normal(k_emb, (V, H), jnp.float32) * H**-0.5).astype(dtype)
+    lm_head = (
+        embed.T
+        if cfg.tie_word_embeddings
+        else (jax.random.normal(k_head, (H, V), jnp.float32) * H**-0.5).astype(dtype)
+    )
+    return {
+        "embed": embed,
+        "layers": init_layer_params(cfg, k_layers, cfg.num_hidden_layers, dtype),
+        "final_norm": jnp.ones((H,), dtype),
+        "lm_head": lm_head,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Forward blocks
+# ---------------------------------------------------------------------------
+
+def embed(params: Params, token_ids: jnp.ndarray) -> jnp.ndarray:
+    """Token embedding — the privacy boundary: requests enter the chain as
+    embeddings, never raw token ids (≙ ``/root/reference/utils/node_worker.py:
+    215-223`` and README privacy note)."""
+    return params["embed"][token_ids]
+
+
+def decoder_layer(
+    cfg: ModelConfig,
+    p: Params,  # un-stacked single-layer params
+    h: jnp.ndarray,  # [B, S, H]
+    k_row: jnp.ndarray,  # [B, C, Nkv, D] cache row for this layer
+    v_row: jnp.ndarray,
+    cos: jnp.ndarray,  # [B, S, D]
+    sin: jnp.ndarray,
+    positions: jnp.ndarray,  # [B, S] absolute query positions
+    kv_positions: jnp.ndarray,  # [B, C] per-slot key positions (post-write)
+    length: jnp.ndarray,  # scalar int32: shared write offset for this step
+):
+    B, S, H = h.shape
+    D = cfg.head_dim_
+    Nh, Nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+
+    x = rms_norm(h, p["input_norm"], cfg.rms_norm_eps)
+    q = (x @ p["wq"]).reshape(B, S, Nh, D)
+    k = (x @ p["wk"]).reshape(B, S, Nkv, D)
+    v = (x @ p["wv"]).reshape(B, S, Nkv, D)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    k_row = jax.lax.dynamic_update_slice(k_row, k.astype(k_row.dtype), (0, length, 0, 0))
+    v_row = jax.lax.dynamic_update_slice(v_row, v.astype(v_row.dtype), (0, length, 0, 0))
+
+    attn = cached_attention(q, k_row, v_row, positions, kv_positions)
+    h = h + attn.reshape(B, S, Nh * D) @ p["wo"]
+
+    x = rms_norm(h, p["post_norm"], cfg.rms_norm_eps)
+    mlp = (jax.nn.silu((x @ p["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+           * (x @ p["w_up"])) @ p["w_down"]
+    return h + mlp, k_row, v_row
+
+
+def forward_layers(
+    cfg: ModelConfig,
+    layers: Params,  # stacked [L, ...]
+    h: jnp.ndarray,
+    cache: KVCache,
+    positions: jnp.ndarray,
+    layer_mask: Optional[jnp.ndarray] = None,  # [L] bool — False = pass-through
+) -> tuple[jnp.ndarray, KVCache]:
+    """Run ``h`` through a stack of decoder layers via ``lax.scan``.
+
+    ``layer_mask`` enables ragged pipeline stages: masked-out layers leave the
+    hidden state and their cache rows untouched, so every stage can scan the
+    same (padded) layer count in one SPMD program (SURVEY.md §7 "uneven layer
+    splits").
+    """
+    cos, sin = rope_cos_sin(positions, cfg, dtype=jnp.float32)
+
+    def apply(p, h, k_row, v_row, kv_pos, length):
+        return decoder_layer(
+            cfg, p, h, k_row, v_row, cos, sin, positions, kv_pos, length
+        )
+
+    return scan_layers(layers, h, cache, positions, apply, layer_mask)
+
+
+def final_logits(cfg: ModelConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
+    """Final norm + lm_head (≙ the reference's last-node role,
+    ``/root/reference/utils/node_worker.py:155-164, 260-265``)."""
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    return (h @ params["lm_head"]).astype(jnp.float32)
+
+
+def forward(
+    cfg: ModelConfig,
+    params: Params,
+    token_ids: jnp.ndarray,  # [B, S]
+    cache: KVCache,
+    positions: jnp.ndarray,  # [B, S]
+) -> tuple[jnp.ndarray, KVCache]:
+    """Full-model step: embed → layers → logits. The monolithic oracle path
+    (≙ ``/root/reference/inference.py`` and
+    ``utils/node_profiler.py:1238-1331``)."""
+    h = embed(params, token_ids)
+    h, cache = forward_layers(cfg, params["layers"], h, cache, positions)
+    return final_logits(cfg, params, h), cache

@@ -1,0 +1,52 @@
+"""Shared layer-stack scan machinery (llama + gpt2 + future families).
+
+One implementation of: record this step's key positions, ``lax.scan`` over
+layer-stacked params + per-layer cache rows, commit hidden/cache updates only
+for valid (non-padding) layers. Architecture modules supply only the per-layer
+function. Centralizing this keeps the ragged-stage and cache-write semantics
+identical across model families (they power the pipeline's SPMD padding —
+SURVEY.md §7 "uneven layer splits").
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .cache import KVCache
+
+# apply_layer(p, h, k_row, v_row, kv_pos, length) -> (h, k_row, v_row)
+ApplyLayerFn = Callable
+
+
+def scan_layers(
+    layers,
+    h: jnp.ndarray,
+    cache: KVCache,
+    positions: jnp.ndarray,
+    apply_layer: ApplyLayerFn,
+    layer_mask: Optional[jnp.ndarray] = None,
+) -> tuple[jnp.ndarray, KVCache]:
+    S = h.shape[1]
+    L = cache.num_layers
+    if layer_mask is None:
+        layer_mask = jnp.ones((L,), bool)
+
+    # Record this step's key positions once — shared by every layer.
+    kv_pos = jax.lax.dynamic_update_slice(
+        cache.pos, positions.astype(jnp.int32), (0, cache.length)
+    )
+
+    def body(carry, xs):
+        h = carry
+        p, k_row, v_row, valid = xs
+        h_new, k_new, v_new = apply_layer(p, h, k_row, v_row, kv_pos, cache.length)
+        h = jnp.where(valid, h_new, h)
+        k_row = jnp.where(valid, k_new, k_row)
+        v_row = jnp.where(valid, v_new, v_row)
+        return h, (k_row, v_row)
+
+    h, (k_all, v_all) = jax.lax.scan(body, h, (layers, cache.k, cache.v, layer_mask))
+    return h, KVCache(k=k_all, v=v_all, pos=kv_pos, length=cache.length + S)

@@ -1,0 +1,1 @@
+from . import attention, norms, rope, sampling  # noqa: F401

@@ -1,0 +1,58 @@
+"""Cached causal attention (GQA-aware), pure JAX.
+
+Replaces the native kernels under HF's attention path (cuBLAS/SDPA, reached
+via ``LlamaDecoderLayer`` at ``/root/reference/utils/shard_loader.py:66-74``)
+with XLA-compiled einsums sized for the MXU. The KV cache is an explicit
+fixed-capacity array (see ``models/cache.py``) rather than HF ``DynamicCache``
+(``/root/reference/utils/node_worker.py:184``): queries attend over the whole
+capacity with a mask built from absolute positions, so prefill (S>1) and
+decode (S=1) share one code path and one compiled shape per (B, S, C).
+
+The reference never passes an attention mask (fine for batch-1 causal+cache,
+``utils/node_worker.py:255``); here the mask is explicit, which also gives
+correct batched decode — a capability the reference lacks (SURVEY.md §2, DP
+row).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def cached_attention(
+    q: jnp.ndarray,  # [B, S, Nh, D] — already RoPE'd if applicable
+    k_cache: jnp.ndarray,  # [B, C, Nkv, D] — new keys already written
+    v_cache: jnp.ndarray,  # [B, C, Nkv, D]
+    q_positions: jnp.ndarray,  # [B, S] absolute positions of the queries
+    kv_positions: jnp.ndarray,  # [B, C] absolute position of each cache slot's
+    #   key; empty/pad slots carry POS_SENTINEL and are masked out automatically
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Causal attention of ``q`` over the cache. Returns ``[B, S, Nh, D]``.
+
+    The mask is position-based (``kv_pos <= q_pos``), not slot-index-based, so
+    one rule covers prefill, decode, right-padded batches, and uninitialized
+    cache slots. GQA: ``Nh`` must be a multiple of ``Nkv``; query heads are
+    grouped. Softmax in fp32 (bf16 activations otherwise).
+    """
+    B, S, Nh, D = q.shape
+    C, Nkv = k_cache.shape[1], k_cache.shape[2]
+    G = Nh // Nkv
+    if scale is None:
+        scale = D ** -0.5
+
+    qg = q.reshape(B, S, Nkv, G, D)
+    # scores[b, k, g, s, t] = q[b,s,(k,g)] · key[b,t,k]
+    scores = jnp.einsum(
+        "bskgd,btkd->bkgst", qg.astype(jnp.float32), k_cache.astype(jnp.float32)
+    ) * scale
+
+    mask = kv_positions[:, None, :] <= q_positions[:, :, None]  # [B, S, C]
+    mask = mask[:, None, None, :, :]  # [B,1,1,S,C]
+    scores = jnp.where(mask, scores, jnp.float32(-1e30))
+
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v_cache.astype(jnp.float32))
+    return out.reshape(B, S, Nh, D).astype(q.dtype)

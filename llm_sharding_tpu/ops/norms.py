@@ -1,0 +1,31 @@
+"""Normalization ops (pure JAX; XLA fuses these into neighboring matmuls).
+
+Replaces the reference's dependence on HF ``LlamaRMSNorm``
+(``/root/reference/utils/shard_loader.py:5, 49-55``) and GPT-2's LayerNorm
+(``utils/model_sharder.py:110-118``). Accumulation is fp32 regardless of the
+activation dtype — matching HF semantics so converted weights reproduce logits.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    x32 = x32 * jax.lax.rsqrt(var + eps)
+    return (x32.astype(dtype)) * weight
+
+
+def layer_norm(
+    x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray, eps: float
+) -> jnp.ndarray:
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) * (var + eps) ** -0.5
+    return y.astype(dtype) * weight + bias

@@ -1,0 +1,73 @@
+"""Rotary position embeddings.
+
+The reference computes RoPE (cos, sin) once on chain-node 0 via HF
+``LlamaRotaryEmbedding`` and *ships the tables along the chain* with every
+activation hop (``/root/reference/utils/node_worker.py:149-153, 238-243,
+267-272``). On TPU, recomputation beats communication: every stage derives
+(cos, sin) locally from the scalar position carried in the decode state
+(SURVEY.md §2 "cos/sin shipping becomes unnecessary").
+
+Conventions match HF's ``rotate_half`` formulation so that weights converted
+from HF checkpoints reproduce logits exactly. Includes Llama-3 frequency
+scaling (``rope_type="llama3"``) for the Llama-3-8B config ladder entry
+(BASELINE.md config #4).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..models.config import ModelConfig, RopeScaling
+
+
+def _llama3_scale_inv_freq(inv_freq: np.ndarray, rs: RopeScaling) -> np.ndarray:
+    """Piecewise frequency scaling used by Llama-3.x (HF `_compute_llama3_parameters`)."""
+    low_freq_wavelen = rs.original_max_position_embeddings / rs.low_freq_factor
+    high_freq_wavelen = rs.original_max_position_embeddings / rs.high_freq_factor
+    wavelen = 2 * np.pi / inv_freq
+    # wavelen < high → keep; wavelen > low → scale by 1/factor; else smooth blend
+    scaled = np.where(wavelen > low_freq_wavelen, inv_freq / rs.factor, inv_freq)
+    smooth = (rs.original_max_position_embeddings / wavelen - rs.low_freq_factor) / (
+        rs.high_freq_factor - rs.low_freq_factor
+    )
+    smoothed = (1 - smooth) / rs.factor * inv_freq + smooth * inv_freq
+    is_medium = ~(wavelen < high_freq_wavelen) & ~(wavelen > low_freq_wavelen)
+    return np.where(is_medium, smoothed, scaled)
+
+
+def inv_frequencies(cfg: ModelConfig) -> np.ndarray:
+    """Static (trace-time) inverse frequencies, shape [head_dim/2], fp32."""
+    d = cfg.head_dim_
+    inv_freq = 1.0 / (
+        cfg.rope_theta ** (np.arange(0, d, 2, dtype=np.float64) / d)
+    ).astype(np.float64)
+    if cfg.rope_scaling is not None and cfg.rope_scaling.rope_type == "llama3":
+        inv_freq = _llama3_scale_inv_freq(inv_freq, cfg.rope_scaling)
+    return inv_freq.astype(np.float32)
+
+
+def rope_cos_sin(
+    positions: jnp.ndarray, cfg: ModelConfig, dtype=jnp.float32
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables for absolute ``positions`` (any shape ``[...]``).
+
+    Returns ``cos, sin`` of shape ``[..., head_dim]`` (HF layout: the half
+    frequencies tiled twice, consumed by :func:`apply_rope`).
+    """
+    inv_freq = jnp.asarray(inv_frequencies(cfg))  # [D/2]
+    freqs = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., D/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [..., D]
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def apply_rope(
+    x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray
+) -> jnp.ndarray:
+    """Rotate ``x: [B, S, N, D]`` by per-position ``cos/sin: [B, S, D]``."""
+    c = cos[:, :, None, :].astype(jnp.float32)
+    s = sin[:, :, None, :].astype(jnp.float32)
+    x32 = x.astype(jnp.float32)
+    half = x.shape[-1] // 2
+    rotated = jnp.concatenate([-x32[..., half:], x32[..., :half]], axis=-1)
+    return (x32 * c + rotated * s).astype(x.dtype)

@@ -1,0 +1,32 @@
+"""Token selection + stop predicates, shared by the single-host and pipeline
+decode loops.
+
+Greedy argmax is the reference's only sampler
+(``/root/reference/utils/node_worker.py:262-265``); temperature/top-k are
+additive capability. Stop semantics (any EOS id, ``node_worker.py:290-292``)
+must match everywhere, so they live here once.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..models.config import ModelConfig
+
+
+def is_stop(cfg: ModelConfig, token: jnp.ndarray) -> jnp.ndarray:
+    """token: [B] int32 → bool [B]; true if the token is any stop id."""
+    stops = jnp.asarray(cfg.eos_token_ids, jnp.int32)
+    return jnp.any(token[:, None] == stops[None, :], axis=-1)
+
+
+def sample(logits: jnp.ndarray, key, temperature: float, top_k: int) -> jnp.ndarray:
+    """logits: [B, V] → [B] int32. ``temperature <= 0`` means greedy."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits).astype(jnp.int32)

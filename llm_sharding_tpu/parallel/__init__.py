@@ -1,0 +1,1 @@
+from . import mesh, pipeline, placement  # noqa: F401

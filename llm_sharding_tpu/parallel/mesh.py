@@ -1,0 +1,50 @@
+"""Device-mesh construction.
+
+Replaces the reference's IP:port topology (``src_addr``/``dst_addr`` config
+keys wired into ZMQ sockets, ``/root/reference/utils/config_sender.py:33-40``,
+``utils/node_worker.py:20-29``) with a ``jax.sharding.Mesh``: chain position
+IS mesh coordinate, and the stage→stage hop rides ICI via ``lax.ppermute``
+instead of TCP. Multi-host (the reference's multiple-Jetson deployment) is the
+same code over a multi-host mesh — ``jax.distributed.initialize`` + the global
+device list, with XLA routing ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+PIPE_AXIS = "pipe"  # pipeline-chain axis (≙ the reference's device chain)
+DATA_AXIS = "data"  # batch/data-parallel axis (capability the reference lacks)
+SEQ_AXIS = "seq"  # sequence/context-parallel axis (ring attention)
+
+
+def pipeline_mesh(
+    num_stages: int, devices: Optional[Sequence] = None
+) -> Mesh:
+    """1-D mesh over the pipeline axis; one stage per device
+    (BASELINE north star: "one NodeController per TPU chip")."""
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < num_stages:
+        raise ValueError(
+            f"need {num_stages} devices for {num_stages} stages, have "
+            f"{len(devices)}"
+        )
+    return Mesh(np.asarray(devices[:num_stages]), (PIPE_AXIS,))
+
+
+def pipeline_data_mesh(
+    num_stages: int, data_parallel: int, devices: Optional[Sequence] = None
+) -> Mesh:
+    """2-D mesh: replicate the whole chain ``data_parallel`` times. The pipe
+    axis is the minor (fastest-varying) axis so each chain's hops stay on
+    neighboring devices/ICI links."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = num_stages * data_parallel
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    arr = np.asarray(devices[:need]).reshape(data_parallel, num_stages)
+    return Mesh(arr, (DATA_AXIS, PIPE_AXIS))

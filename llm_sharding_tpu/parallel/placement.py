@@ -1,0 +1,126 @@
+"""Placement: mapping layer ranges onto mesh devices.
+
+TPU-native control plane replacing the reference's master-side ``ConfigSender``
+(``/root/reference/utils/config_sender.py:4-47``): where the reference pushes
+``{src_addr, dst_addr, can_receive_user_request, first_node_addr,
+shards_start, shards_end}`` JSON dicts to per-device controller processes over
+ZMQ, here a ``PlacementSpec`` maps each pipeline stage's ``[start, end)``
+layer range onto a position along the mesh's "pipe" axis, and "sending the
+config" becomes constructing (or re-constructing) the sharded computation.
+
+Validation mirrors the reference's (``config_sender.py:29-31``,
+``node_worker.py:134-135``) plus the chain-coverage checks the reference
+leaves to the operator. Ragged splits (e.g. the 6/1/25 example in
+``/root/reference/send_config.py:10-34``) are supported by padding every
+stage to ``max_layers_per_stage`` with masked layers, so one SPMD program
+serves any split.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class PlacementSpec:
+    """stages[i] = (start, end) layer range of pipeline stage i (chain order).
+
+    Stage 0 is user-facing (holds the embedding; ≙ ``can_receive_user_request``,
+    ``/root/reference/utils/node_worker.py:105-107``); the last stage holds
+    final-norm + lm_head (``:155-164``).
+    """
+
+    stages: tuple  # tuple[tuple[int, int], ...]
+    num_layers: int
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "stages", tuple((int(a), int(b)) for a, b in self.stages)
+        )
+        self.validate()
+
+    def validate(self) -> None:
+        if not self.stages:
+            raise ValueError("placement needs at least one stage")
+        prev_end = 0
+        for i, (start, end) in enumerate(self.stages):
+            if not (0 <= start < end <= self.num_layers):
+                raise ValueError(
+                    f"stage {i}: invalid layer range [{start}, {end}) for "
+                    f"{self.num_layers}-layer model"
+                )
+            if start != prev_end:
+                raise ValueError(
+                    f"stage {i} starts at layer {start}, but previous stage "
+                    f"ended at {prev_end}: chain must cover layers contiguously"
+                )
+            prev_end = end
+        if prev_end != self.num_layers:
+            raise ValueError(
+                f"chain covers layers [0, {prev_end}) but the model has "
+                f"{self.num_layers} layers"
+            )
+
+    @property
+    def num_stages(self) -> int:
+        return len(self.stages)
+
+    @property
+    def max_layers_per_stage(self) -> int:
+        return max(end - start for start, end in self.stages)
+
+    @classmethod
+    def balanced(cls, num_layers: int, num_stages: int) -> "PlacementSpec":
+        """Even split, earlier stages take the remainder (the scheduler the
+        reference's profiler feeds was meant to compute non-even splits from
+        device capabilities; see ``utils/profiler.py`` for that input)."""
+        if num_stages < 1 or num_stages > num_layers:
+            raise ValueError(
+                f"num_stages must be in [1, {num_layers}], got {num_stages}"
+            )
+        base, rem = divmod(num_layers, num_stages)
+        stages, cursor = [], 0
+        for i in range(num_stages):
+            n = base + (1 if i < rem else 0)
+            stages.append((cursor, cursor + n))
+            cursor += n
+        return cls(tuple(stages), num_layers)
+
+    @classmethod
+    def from_ranges(
+        cls, ranges: Sequence[tuple[int, int]], num_layers: int
+    ) -> "PlacementSpec":
+        return cls(tuple(ranges), num_layers)
+
+
+def stack_stage_params(
+    spec: PlacementSpec, full_layers: dict[str, jnp.ndarray]
+) -> tuple[dict[str, jnp.ndarray], jnp.ndarray]:
+    """Slice full-model stacked layers [L, ...] into per-stage padded stacks.
+
+    Returns ``(stage_layers, layer_masks)`` where each ``stage_layers`` leaf is
+    ``[num_stages, max_layers_per_stage, ...]`` (shard axis 0 over "pipe") and
+    ``layer_masks`` is ``[num_stages, max_layers_per_stage]`` bool.
+    """
+    P = spec.max_layers_per_stage
+
+    def slice_leaf(leaf: jnp.ndarray) -> jnp.ndarray:
+        parts = []
+        for start, end in spec.stages:
+            chunk = leaf[start:end]
+            if end - start < P:
+                pad = jnp.zeros((P - (end - start), *chunk.shape[1:]), chunk.dtype)
+                chunk = jnp.concatenate([chunk, pad], axis=0)
+            parts.append(chunk)
+        return jnp.stack(parts)
+
+    stage_layers = jax.tree.map(slice_leaf, full_layers)
+    masks = np.zeros((spec.num_stages, P), bool)
+    for i, (start, end) in enumerate(spec.stages):
+        masks[i, : end - start] = True
+    return stage_layers, jnp.asarray(masks)

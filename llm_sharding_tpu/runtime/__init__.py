@@ -1,0 +1,1 @@
+from . import generate  # noqa: F401

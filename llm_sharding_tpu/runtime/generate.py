@@ -1,0 +1,225 @@
+"""Single-host autoregressive generation — the monolithic oracle + serving core.
+
+Replaces the reference's two oracles — HF ``model.generate`` in
+``/root/reference/inference.py:36-45`` and the hand-rolled in-process loop in
+``utils/node_profiler.py:1238-1331`` — with a decode loop that lives entirely
+inside one compiled XLA program: ``lax.while_loop`` over single-token steps,
+greedy argmax (the reference is greedy-only, ``utils/node_worker.py:262-265``)
+plus temperature/top-k sampling the reference lacks, and stop conditions with
+the reference's semantics (any EOS id, or max-new-tokens;
+``utils/node_worker.py:290-292``).
+
+Host-boundary contract: ``prompt_len + max_new_tokens`` must fit the cache
+capacity — validated here BEFORE tracing, because inside jit the
+dynamic-update-slice would silently clamp (see ``models/cache.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Iterator, NamedTuple, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..models import gpt2, llama
+from ..models.cache import KVCache, POS_SENTINEL, init_cache
+from ..models.config import ModelConfig
+from ..ops.sampling import is_stop as _is_stop_op, sample as _sample_op
+
+ForwardFn = Callable[..., tuple[jnp.ndarray, KVCache]]
+
+
+def forward_fn_for(cfg: ModelConfig) -> ForwardFn:
+    """Architecture dispatch (≙ the llama/gpt branch in
+    ``/root/reference/utils/model_sharder.py:64,96``)."""
+    return {"llama": llama.forward, "gpt2": gpt2.forward}[cfg.model_type]
+
+
+_is_stop = _is_stop_op
+_sample = _sample_op
+
+
+class GenerateResult(NamedTuple):
+    tokens: np.ndarray  # [B, prompt+max_new] padded with pad_id after stop
+    lengths: np.ndarray  # [B] total valid length (prompt + generated incl. EOS)
+    cache: KVCache
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "temperature", "top_k", "fwd"),
+)
+def _generate_jit(
+    cfg: ModelConfig,
+    params: Any,
+    prompt: jnp.ndarray,  # [B, S]
+    prompt_len: jnp.ndarray,  # [B] actual lengths (left of it is real, rest pad)
+    cache: KVCache,
+    key: jnp.ndarray,
+    max_new_tokens: int,
+    temperature: float,
+    top_k: int,
+    fwd: ForwardFn,
+):
+    B, S = prompt.shape
+    total = S + max_new_tokens
+
+    # Padded slots get the sentinel position so their keys are never attended
+    # (see models/cache.py) — this is what makes right-padded batching exact.
+    idx = jnp.arange(S, dtype=jnp.int32)
+    positions = jnp.where(idx[None, :] < prompt_len[:, None], idx[None, :], POS_SENTINEL)
+    logits, cache = fwd(cfg, params, prompt, cache, positions)
+    # Last *real* prompt token's logits per row (rows may be right-padded).
+    last = jnp.take_along_axis(logits, (prompt_len - 1)[:, None, None], axis=1)[:, 0]
+
+    key, sub = jax.random.split(key)
+    first_tok = _sample(last, sub, temperature, top_k)
+
+    out = jnp.zeros((B, total), jnp.int32)
+    out = jax.lax.dynamic_update_slice(out, prompt, (0, 0))
+    out = out.at[jnp.arange(B), prompt_len].set(first_tok)
+
+    state = dict(
+        out=out,
+        cache=cache,
+        tok=first_tok,
+        pos=prompt_len,  # position of `tok` in the sequence
+        done=_is_stop(cfg, first_tok),
+        n=jnp.ones((), jnp.int32),
+        key=key,
+        lengths=prompt_len + 1,
+    )
+
+    def cond(s):
+        return (s["n"] < max_new_tokens) & ~jnp.all(s["done"])
+
+    def body(s):
+        tok = s["tok"][:, None]
+        pos = s["pos"][:, None]
+        logits, cache = fwd(cfg, params, tok, s["cache"], pos)
+        key, sub = jax.random.split(s["key"])
+        nxt = _sample(logits[:, 0], sub, temperature, top_k)
+        nxt = jnp.where(s["done"], 0, nxt)
+        new_pos = s["pos"] + 1
+        out = s["out"].at[jnp.arange(B), new_pos].set(nxt)
+        done = s["done"] | _is_stop(cfg, nxt)
+        return dict(
+            out=out,
+            cache=cache,
+            tok=nxt,
+            pos=new_pos,
+            done=done,
+            n=s["n"] + 1,
+            key=key,
+            lengths=jnp.where(s["done"], s["lengths"], s["lengths"] + 1),
+        )
+
+    state = jax.lax.while_loop(cond, body, state)
+    return state["out"], state["lengths"], state["cache"]
+
+
+def generate(
+    cfg: ModelConfig,
+    params: Any,
+    prompt_ids: np.ndarray | jnp.ndarray,  # [B, S] (right-padded) or [S]
+    max_new_tokens: int = 128,
+    *,
+    prompt_len: Optional[np.ndarray] = None,
+    capacity: Optional[int] = None,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    seed: int = 0,
+    cache_dtype=jnp.bfloat16,
+) -> GenerateResult:
+    """End-to-end generation in one compiled program."""
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    if prompt_ids.ndim == 1:
+        prompt_ids = prompt_ids[None]
+    B, S = prompt_ids.shape
+    if prompt_len is None:
+        prompt_len = jnp.full((B,), S, jnp.int32)
+    else:
+        prompt_len = jnp.asarray(prompt_len, jnp.int32)
+
+    total = S + max_new_tokens
+    capacity = capacity or total
+    if total > capacity:
+        raise ValueError(
+            f"prompt ({S}) + max_new_tokens ({max_new_tokens}) exceeds KV cache "
+            f"capacity ({capacity}); raise capacity or shorten the request"
+        )
+    if total > cfg.max_position_embeddings:
+        raise ValueError(
+            f"requested {total} positions > max_position_embeddings "
+            f"({cfg.max_position_embeddings})"
+        )
+
+    cache = init_cache(cfg, B, capacity, dtype=cache_dtype)
+    out, lengths, cache = _generate_jit(
+        cfg,
+        params,
+        prompt_ids,
+        prompt_len,
+        cache,
+        jax.random.key(seed),
+        max_new_tokens,
+        float(temperature),
+        int(top_k),
+        forward_fn_for(cfg),
+    )
+    return GenerateResult(np.asarray(out), np.asarray(lengths), cache)
+
+
+def generate_stream(
+    cfg: ModelConfig,
+    params: Any,
+    prompt_ids: np.ndarray | jnp.ndarray,  # [1, S] or [S] — streaming is per-request
+    max_new_tokens: int = 128,
+    *,
+    capacity: Optional[int] = None,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    seed: int = 0,
+    cache_dtype=jnp.bfloat16,
+) -> Iterator[int]:
+    """Token-by-token streaming decode (≙ the reference's streamed
+    ``tokenizer.decode`` prints, ``/root/reference/utils/node_worker.py:
+    286-298``). Yields token ids as they are produced; stops on any EOS or
+    ``max_new_tokens``."""
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    if prompt_ids.ndim == 1:
+        prompt_ids = prompt_ids[None]
+    B, S = prompt_ids.shape
+    if B != 1:
+        raise ValueError("streaming decode is per-request (batch=1)")
+    capacity = capacity or (S + max_new_tokens)
+    if S + max_new_tokens > capacity:
+        raise ValueError("prompt + max_new_tokens exceeds cache capacity")
+
+    fwd = forward_fn_for(cfg)
+    step = jax.jit(
+        lambda p, ids, c, pos: fwd(cfg, p, ids, c, pos)
+    )
+
+    cache = init_cache(cfg, B, capacity, dtype=cache_dtype)
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    logits, cache = step(params, prompt_ids, cache, positions)
+    key = jax.random.key(seed)
+
+    tok_arr = None
+    pos = S
+    for i in range(max_new_tokens):
+        key, sub = jax.random.split(key)
+        last = logits[:, -1] if tok_arr is None else logits[:, 0]
+        tok_arr = _sample(last, sub, temperature, top_k)
+        tok = int(tok_arr[0])
+        yield tok
+        if tok in cfg.eos_token_ids:
+            return
+        if i + 1 < max_new_tokens:
+            logits, cache = step(
+                params, tok_arr[:, None], cache, jnp.full((B, 1), pos, jnp.int32)
+            )
+            pos += 1

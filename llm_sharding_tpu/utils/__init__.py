@@ -1,0 +1,1 @@
+from . import convert, shard_store  # noqa: F401

@@ -1,0 +1,138 @@
+"""HF checkpoint → JAX parameter pytree conversion.
+
+TPU-native counterpart of the reference's offline ``ModelSharder``
+(``/root/reference/utils/model_sharder.py:7-134``): where the reference loads
+the full torch model and ``torch.save``s ``embedding.pth`` / ``block_{i}.pth``
+/ ``final_norm.pth`` / ``lm_head.pth``, this module maps HF weight names to
+the pytree layout of ``models/llama.py`` / ``models/gpt2.py`` (layer-stacked
+arrays ready for ``lax.scan``). Both reference architectures are covered:
+"llama" (``model_sharder.py:64-94``) and "gpt" (``model_sharder.py:96-132``).
+
+Inputs are name→numpy mappings, so the source can be torch state dicts (tests)
+or safetensors files streamed tensor-by-tensor (``shard_store.py``) without
+ever materializing the full model in host memory at once — the reference needs
+one big-memory machine for this step (``/root/reference/README.md:29``); we
+don't.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Mapping
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..models.config import ModelConfig
+
+TensorGetter = Callable[[str], np.ndarray]
+
+
+def _getter(src: Mapping[str, np.ndarray] | TensorGetter) -> TensorGetter:
+    if callable(src):
+        return src
+    return lambda name: np.asarray(src[name])
+
+
+def llama_layer_arrays(
+    cfg: ModelConfig, get: TensorGetter, i: int, dtype
+) -> dict[str, jnp.ndarray]:
+    """One decoder layer's params (un-stacked), ≙ ``block_{i}.pth``."""
+    if cfg.attention_bias or cfg.mlp_bias:
+        raise ValueError(
+            "attention_bias/mlp_bias checkpoints are not wired through yet; "
+            "refusing to silently drop bias tensors"
+        )
+    pre = f"model.layers.{i}."
+
+    def lin(name):  # torch Linear stores [out, in]; we use [in, out]
+        return jnp.asarray(get(pre + name + ".weight").T, dtype)
+
+    return {
+        "input_norm": jnp.asarray(get(pre + "input_layernorm.weight"), dtype),
+        "wq": lin("self_attn.q_proj"),
+        "wk": lin("self_attn.k_proj"),
+        "wv": lin("self_attn.v_proj"),
+        "wo": lin("self_attn.o_proj"),
+        "post_norm": jnp.asarray(get(pre + "post_attention_layernorm.weight"), dtype),
+        "w_gate": lin("mlp.gate_proj"),
+        "w_up": lin("mlp.up_proj"),
+        "w_down": lin("mlp.down_proj"),
+    }
+
+
+def gpt2_layer_arrays(
+    cfg: ModelConfig, get: TensorGetter, i: int, dtype
+) -> dict[str, jnp.ndarray]:
+    """One GPT-2 block (HF Conv1D stores [in, out] — no transpose),
+    ≙ the reference's gpt branch bundling h.{i} into ``block_{i}.pth``
+    (``/root/reference/utils/model_sharder.py:119-126``)."""
+    pre = f"transformer.h.{i}." if _has(get, f"transformer.h.{i}.ln_1.weight") else f"h.{i}."
+
+    def t(name):
+        return jnp.asarray(get(pre + name), dtype)
+
+    return {
+        "ln1_w": t("ln_1.weight"),
+        "ln1_b": t("ln_1.bias"),
+        "w_qkv": t("attn.c_attn.weight"),
+        "b_qkv": t("attn.c_attn.bias"),
+        "w_proj": t("attn.c_proj.weight"),
+        "b_proj": t("attn.c_proj.bias"),
+        "ln2_w": t("ln_2.weight"),
+        "ln2_b": t("ln_2.bias"),
+        "w_fc": t("mlp.c_fc.weight"),
+        "b_fc": t("mlp.c_fc.bias"),
+        "w_out": t("mlp.c_proj.weight"),
+        "b_out": t("mlp.c_proj.bias"),
+    }
+
+
+def _has(get: TensorGetter, name: str) -> bool:
+    try:
+        get(name)
+        return True
+    except KeyError:
+        return False
+
+
+def _stack(layer_dicts: list[dict[str, jnp.ndarray]]) -> dict[str, jnp.ndarray]:
+    return {k: jnp.stack([d[k] for d in layer_dicts]) for k in layer_dicts[0]}
+
+
+def params_from_hf(
+    cfg: ModelConfig,
+    src: Mapping[str, np.ndarray] | TensorGetter,
+    dtype=jnp.bfloat16,
+) -> dict:
+    """Full-model params pytree from an HF name→tensor source."""
+    get = _getter(src)
+    if cfg.model_type == "llama":
+        embed = jnp.asarray(get("model.embed_tokens.weight"), dtype)
+        layers = _stack(
+            [llama_layer_arrays(cfg, get, i, dtype) for i in range(cfg.num_hidden_layers)]
+        )
+        if cfg.tie_word_embeddings:
+            lm_head = embed.T
+        else:
+            lm_head = jnp.asarray(get("lm_head.weight").T, dtype)
+        return {
+            "embed": embed,
+            "layers": layers,
+            "final_norm": jnp.asarray(get("model.norm.weight"), dtype),
+            "lm_head": lm_head,
+        }
+    elif cfg.model_type == "gpt2":
+        pre = "transformer." if _has(get, "transformer.wte.weight") else ""
+        wte = jnp.asarray(get(pre + "wte.weight"), dtype)
+        layers = _stack(
+            [gpt2_layer_arrays(cfg, get, i, dtype) for i in range(cfg.num_hidden_layers)]
+        )
+        return {
+            "embed": wte,
+            "pos_embed": jnp.asarray(get(pre + "wpe.weight"), dtype),
+            "layers": layers,
+            "final_norm": jnp.asarray(get(pre + "ln_f.weight"), dtype),
+            "final_norm_bias": jnp.asarray(get(pre + "ln_f.bias"), dtype),
+            "lm_head": wte.T,  # GPT-2 ties lm_head to wte
+        }
+    raise ValueError(f"unsupported model_type: {cfg.model_type!r}")

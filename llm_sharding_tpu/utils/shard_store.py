@@ -1,0 +1,274 @@
+"""Shard store: offline checkpoint splitting + role-conditional stage loading.
+
+TPU-native counterpart of the reference's ``ModelSharder.save_shards``
+(``/root/reference/utils/model_sharder.py:48-134``) and the loading side spread
+across ``NodeWorker.load_shards`` / ``LlamaShardPart``
+(``utils/node_worker.py:127-185``, ``utils/shard_loader.py:13-55``).
+
+Layout mirrors the reference's split logically — one file per unit —
+
+    <out_dir>/                       # dtype-tagged, e.g. llama2-7b_bfloat16
+      config.json                    # ModelConfig (≙ copied HF config.json)
+      tokenizer.*                    # copied tokenizer files (non-weight)
+      embedding.npz                  # ≙ embedding.pth   (embed [+pos_embed])
+      block_{i}.npz                  # ≙ block_{i}.pth   (one decoder layer)
+      final_norm.npz                 # ≙ final_norm.pth / ln_f.pth
+      lm_head.npz                    # ≙ lm_head.pth
+
+— but stores numpy ``.npz`` instead of torch pickles, and the loader stacks a
+stage's ``block_{start..end-1}`` into scan-ready ``[L, ...]`` arrays.
+
+Role-conditional loading reproduces the reference's conditionals exactly:
+embedding iff the stage can receive user requests (``node_worker.py:105-107``),
+final-norm + lm_head iff ``end == num_hidden_layers`` (``:155-164``). RoPE
+needs no table loading — recomputed from positions (see ``ops/rope.py``).
+
+Conversion can stream tensor-by-tensor from safetensors, so no machine ever
+holds the whole model — the reference requires one big-memory machine for this
+step (``/root/reference/README.md:29``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..models.config import ModelConfig
+from .convert import (
+    TensorGetter,
+    _getter,
+    gpt2_layer_arrays,
+    llama_layer_arrays,
+)
+
+# Tokenizer/config files copied verbatim, skipping weights — the same skip
+# rule as /root/reference/utils/model_sharder.py:50-61.
+_WEIGHT_SUFFIXES = (".bin", ".safetensors", ".pth", ".pt", ".gguf")
+
+
+def _save_npz(path: str, arrays: dict[str, Any]) -> None:
+    np.savez(path, **{k: np.asarray(v) for k, v in arrays.items()})
+
+
+def _load_npz(path: str, dtype) -> dict[str, jnp.ndarray]:
+    with np.load(path) as z:
+        return {k: jnp.asarray(z[k], dtype) for k in z.files}
+
+
+def save_shards(
+    cfg: ModelConfig,
+    src: Any,  # full params pytree (from models/*.init_params or convert)
+    out_dir: str,
+    tokenizer_dir: Optional[str] = None,
+) -> None:
+    """Split a full params pytree into the per-unit store."""
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        f.write(cfg.to_json())
+    if tokenizer_dir:
+        copy_tokenizer_files(tokenizer_dir, out_dir)
+
+    emb = {"embed": src["embed"]}
+    if "pos_embed" in src:
+        emb["pos_embed"] = src["pos_embed"]
+    _save_npz(os.path.join(out_dir, "embedding.npz"), emb)
+
+    layers = src["layers"]
+    for i in range(cfg.num_hidden_layers):
+        _save_npz(
+            os.path.join(out_dir, f"block_{i}.npz"),
+            {k: v[i] for k, v in layers.items()},
+        )
+
+    fn = {"final_norm": src["final_norm"]}
+    if "final_norm_bias" in src:
+        fn["final_norm_bias"] = src["final_norm_bias"]
+    _save_npz(os.path.join(out_dir, "final_norm.npz"), fn)
+    _save_npz(os.path.join(out_dir, "lm_head.npz"), {"lm_head": src["lm_head"]})
+
+
+def save_shards_streaming(
+    cfg: ModelConfig,
+    src: TensorGetter | dict,
+    out_dir: str,
+    dtype=jnp.bfloat16,
+    tokenizer_dir: Optional[str] = None,
+) -> None:
+    """Split directly from an HF name→tensor source, one unit at a time."""
+    get = _getter(src)
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        f.write(cfg.to_json())
+    if tokenizer_dir:
+        copy_tokenizer_files(tokenizer_dir, out_dir)
+
+    layer_fn = llama_layer_arrays if cfg.model_type == "llama" else gpt2_layer_arrays
+    for i in range(cfg.num_hidden_layers):
+        _save_npz(os.path.join(out_dir, f"block_{i}.npz"), layer_fn(cfg, get, i, dtype))
+
+    if cfg.model_type == "llama":
+        embed = jnp.asarray(get("model.embed_tokens.weight"), dtype)
+        _save_npz(os.path.join(out_dir, "embedding.npz"), {"embed": embed})
+        _save_npz(
+            os.path.join(out_dir, "final_norm.npz"),
+            {"final_norm": jnp.asarray(get("model.norm.weight"), dtype)},
+        )
+        lm_head = (
+            embed.T
+            if cfg.tie_word_embeddings
+            else jnp.asarray(get("lm_head.weight").T, dtype)
+        )
+        _save_npz(os.path.join(out_dir, "lm_head.npz"), {"lm_head": lm_head})
+    else:  # gpt2
+        from .convert import _has
+
+        pre = "transformer." if _has(get, "transformer.wte.weight") else ""
+        wte = jnp.asarray(get(pre + "wte.weight"), dtype)
+        _save_npz(
+            os.path.join(out_dir, "embedding.npz"),
+            {"embed": wte, "pos_embed": jnp.asarray(get(pre + "wpe.weight"), dtype)},
+        )
+        _save_npz(
+            os.path.join(out_dir, "final_norm.npz"),
+            {
+                "final_norm": jnp.asarray(get(pre + "ln_f.weight"), dtype),
+                "final_norm_bias": jnp.asarray(get(pre + "ln_f.bias"), dtype),
+            },
+        )
+        _save_npz(os.path.join(out_dir, "lm_head.npz"), {"lm_head": wte.T})
+
+
+def copy_tokenizer_files(src_dir: str, out_dir: str) -> None:
+    """Copy config/tokenizer files, skipping weights (≙ the skip rule at
+    ``/root/reference/utils/model_sharder.py:50-61``)."""
+    for name in os.listdir(src_dir):
+        p = os.path.join(src_dir, name)
+        if not os.path.isfile(p):
+            continue
+        if name.endswith(_WEIGHT_SUFFIXES) or name == "config.json":
+            continue
+        shutil.copy2(p, os.path.join(out_dir, name))
+
+
+def load_config(shards_dir: str) -> ModelConfig:
+    with open(os.path.join(shards_dir, "config.json")) as f:
+        return ModelConfig.from_json(f.read())
+
+
+def load_stage(
+    shards_dir: str,
+    start: int,
+    end: int,
+    dtype=jnp.bfloat16,
+    user_facing: Optional[bool] = None,
+    pad_to: Optional[int] = None,
+) -> dict[str, Any]:
+    """Load one pipeline stage's params for layers ``[start, end)``.
+
+    Role conditionals mirror ``NodeWorker.load_shards``
+    (``/root/reference/utils/node_worker.py:127-185``): embedding iff
+    ``user_facing`` (default: ``start == 0``), final norm + lm_head iff
+    ``end == num_hidden_layers``.
+
+    ``pad_to`` pads the stacked layer arrays (and returns ``layer_mask``) so
+    ragged stages share one SPMD program shape.
+    """
+    cfg = load_config(shards_dir)
+    L = cfg.num_hidden_layers
+    if not (0 <= start < end <= L):
+        raise ValueError(f"invalid layer range [{start}, {end}) for {L}-layer model")
+    if user_facing is None:
+        user_facing = start == 0
+
+    blocks = [
+        _load_npz(os.path.join(shards_dir, f"block_{i}.npz"), dtype)
+        for i in range(start, end)
+    ]
+    n = end - start
+    pad_to = pad_to or n
+    if pad_to < n:
+        raise ValueError(f"pad_to={pad_to} < stage size {n}")
+    stacked = {}
+    for k in blocks[0]:
+        arrs = [b[k] for b in blocks]
+        if pad_to > n:
+            arrs += [jnp.zeros_like(arrs[0])] * (pad_to - n)
+        stacked[k] = jnp.stack(arrs)
+
+    stage: dict[str, Any] = {
+        "layers": stacked,
+        "layer_mask": jnp.arange(pad_to) < n,
+        "start": start,
+        "end": end,
+    }
+    if user_facing:
+        stage.update(_load_npz(os.path.join(shards_dir, "embedding.npz"), dtype))
+    if end == L:
+        stage.update(_load_npz(os.path.join(shards_dir, "final_norm.npz"), dtype))
+        stage.update(_load_npz(os.path.join(shards_dir, "lm_head.npz"), dtype))
+    return stage
+
+
+def load_full(shards_dir: str, dtype=jnp.bfloat16) -> tuple[ModelConfig, dict]:
+    """Load the whole model (monolithic oracle path, ≙ ``inference.py``)."""
+    cfg = load_config(shards_dir)
+    stage = load_stage(shards_dir, 0, cfg.num_hidden_layers, dtype, user_facing=True)
+    params = {k: v for k, v in stage.items() if k not in ("layer_mask", "start", "end")}
+    return cfg, params
+
+
+def convert_hf_checkpoint(
+    model_dir: str, out_dir: str, dtype=jnp.bfloat16
+) -> ModelConfig:
+    """Offline conversion entry (≙ running ``ModelSharder`` as a script,
+    ``/root/reference/utils/model_sharder.py:137-145``).
+
+    Reads HF ``config.json`` + ``*.safetensors`` (or torch ``*.bin``) from
+    ``model_dir``, streams tensors, writes the shard store to ``out_dir``.
+    """
+    with open(os.path.join(model_dir, "config.json")) as f:
+        cfg = ModelConfig.from_hf_config(json.load(f))
+
+    st_files = sorted(
+        f for f in os.listdir(model_dir) if f.endswith(".safetensors")
+    )
+    if st_files:
+        from safetensors import safe_open
+
+        # name → open handle; safe_open.get_tensor reads ONE tensor at a time,
+        # which is what keeps conversion memory at ~one-layer scale (the
+        # streaming contract in the module docstring).
+        index: dict[str, Any] = {}
+        for fn in st_files:
+            handle = safe_open(os.path.join(model_dir, fn), framework="numpy")
+            for name in handle.keys():
+                index[name] = handle
+
+        def get(name: str) -> np.ndarray:
+            if name not in index:
+                raise KeyError(name)
+            return index[name].get_tensor(name)
+
+    else:
+        bins = sorted(f for f in os.listdir(model_dir) if f.endswith(".bin"))
+        if not bins:
+            raise FileNotFoundError(f"no safetensors/bin weights in {model_dir}")
+        import torch
+
+        sd: dict[str, np.ndarray] = {}
+        for fn in bins:
+            part = torch.load(
+                os.path.join(model_dir, fn), map_location="cpu", weights_only=True
+            )
+            sd.update({k: v.float().numpy() for k, v in part.items()})
+
+        def get(name: str) -> np.ndarray:
+            return sd[name]
+
+    save_shards_streaming(cfg, get, out_dir, dtype, tokenizer_dir=model_dir)
+    return cfg

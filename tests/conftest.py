@@ -1,0 +1,24 @@
+"""Test harness: simulate an 8-device TPU mesh on CPU.
+
+The reference's "multi-node without a cluster" answer is a localhost ZMQ ring
+inside one process (``/root/reference/utils/node_profiler.py:1174-1236``); the
+JAX-idiomatic replacement is ``--xla_force_host_platform_device_count`` CPU
+devices (SURVEY.md §4).
+
+Environment note: the axon TPU plugin (loaded from sitecustomize) pins
+``jax_platforms`` via jax.config at interpreter start, so the JAX_PLATFORMS
+env var alone is NOT enough here — the config must be updated after import,
+before any backend initialization. XLA_FLAGS must still be set before first
+backend use.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")

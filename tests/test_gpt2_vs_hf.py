@@ -1,0 +1,77 @@
+"""Golden test: pure-JAX GPT-2 == HF transformers (torch CPU) on a tiny config.
+
+Covers the reference's second architecture branch
+(``/root/reference/utils/model_sharder.py:96-132``).
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import torch
+from transformers import GPT2Config, GPT2LMHeadModel
+
+from llm_sharding_tpu.models import gpt2
+from llm_sharding_tpu.models.cache import init_cache
+from llm_sharding_tpu.models.config import tiny_gpt2
+from llm_sharding_tpu.utils.convert import params_from_hf
+
+CFG = tiny_gpt2()
+
+
+@pytest.fixture(scope="module")
+def hf_model():
+    torch.manual_seed(0)
+    hf_cfg = GPT2Config(
+        vocab_size=CFG.vocab_size,
+        n_embd=CFG.hidden_size,
+        n_layer=CFG.num_hidden_layers,
+        n_head=CFG.num_attention_heads,
+        n_positions=CFG.max_position_embeddings,
+        n_inner=CFG.intermediate_size,
+        layer_norm_epsilon=CFG.layer_norm_epsilon,
+        attn_pdrop=0.0,
+        embd_pdrop=0.0,
+        resid_pdrop=0.0,
+    )
+    model = GPT2LMHeadModel(hf_cfg)
+    model.eval()
+    return model
+
+
+@pytest.fixture(scope="module")
+def params(hf_model):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    return params_from_hf(CFG, sd, dtype=jnp.float32)
+
+
+def test_full_sequence_logits_match(hf_model, params):
+    B, S = 2, 9
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, CFG.vocab_size, (B, S)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_model(torch.from_numpy(ids)).logits.numpy()
+
+    cache = init_cache(CFG, B, capacity=S, dtype=jnp.float32)
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    logits, _ = gpt2.forward(CFG, params, jnp.asarray(ids), cache, positions)
+    np.testing.assert_allclose(np.asarray(logits), ref, atol=3e-4, rtol=2e-3)
+
+
+def test_cached_decode_matches_full(hf_model, params):
+    B, S_total, S_prefill = 1, 8, 5
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, CFG.vocab_size, (B, S_total)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_model(torch.from_numpy(ids)).logits.numpy()
+
+    cache = init_cache(CFG, B, capacity=S_total, dtype=jnp.float32)
+    positions = jnp.broadcast_to(jnp.arange(S_prefill), (B, S_prefill))
+    logits, cache = gpt2.forward(CFG, params, jnp.asarray(ids[:, :S_prefill]), cache, positions)
+    np.testing.assert_allclose(np.asarray(logits), ref[:, :S_prefill], atol=3e-4, rtol=2e-3)
+
+    for t in range(S_prefill, S_total):
+        tok = jnp.asarray(ids[:, t : t + 1])
+        pos = jnp.full((B, 1), t, jnp.int32)
+        logits, cache = gpt2.forward(CFG, params, tok, cache, pos)
+        np.testing.assert_allclose(np.asarray(logits)[:, 0], ref[:, t], atol=3e-4, rtol=2e-3)

@@ -1,0 +1,131 @@
+"""Pipeline-vs-monolith token-exact equivalence on a virtual CPU mesh.
+
+The reference validates its chain by eyeballing a localhost 4-node ZMQ ring
+against single-process full-model decode (``/root/reference/utils/
+node_profiler.py:1174-1331``); this is that check, automated: the shard_map
+ppermute pipeline must produce exactly the tokens of the single-program
+oracle, for even and ragged layer splits, batch 1 and batched.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from llm_sharding_tpu.models import llama
+from llm_sharding_tpu.models.config import tiny_llama, tiny_gpt2
+from llm_sharding_tpu.parallel.mesh import pipeline_mesh
+from llm_sharding_tpu.parallel.pipeline import pipeline_generate
+from llm_sharding_tpu.parallel.placement import PlacementSpec, stack_stage_params
+from llm_sharding_tpu.runtime.generate import generate
+
+CFG = tiny_llama(num_hidden_layers=8)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.key(3), dtype=jnp.float32)
+
+
+def _head_params(params):
+    return {k: v for k, v in params.items() if k != "layers"}
+
+
+def _run_pipeline(cfg, params, spec, prompt, N, **kw):
+    mesh = pipeline_mesh(spec.num_stages)
+    stage_layers, masks = stack_stage_params(spec, params["layers"])
+    return pipeline_generate(
+        cfg, mesh, stage_layers, masks, _head_params(params), prompt, N,
+        cache_dtype=jnp.float32, **kw,
+    )
+
+
+def test_even_split_token_exact(params):
+    prompt = np.array([[5, 3, 11, 2, 9, 1]], dtype=np.int32)
+    N = 10
+    oracle = generate(CFG, params, prompt, N, cache_dtype=jnp.float32)
+    spec = PlacementSpec.balanced(CFG.num_hidden_layers, 4)
+    res = _run_pipeline(CFG, params, spec, prompt, N)
+    np.testing.assert_array_equal(res.tokens, oracle.tokens)
+    np.testing.assert_array_equal(res.lengths, oracle.lengths)
+
+
+def test_ragged_split_token_exact(params):
+    """Uneven chain like the reference's 6/1/25 example
+    (``/root/reference/send_config.py:10-34``) — here 5/1/2 over 8 layers."""
+    prompt = np.array([[7, 7, 3]], dtype=np.int32)
+    N = 8
+    oracle = generate(CFG, params, prompt, N, cache_dtype=jnp.float32)
+    spec = PlacementSpec.from_ranges([(0, 5), (5, 6), (6, 8)], CFG.num_hidden_layers)
+    res = _run_pipeline(CFG, params, spec, prompt, N)
+    np.testing.assert_array_equal(res.tokens, oracle.tokens)
+
+
+def test_single_stage_degenerate(params):
+    """1-stage pipeline == monolith (chain of length one)."""
+    prompt = np.array([[4, 2]], dtype=np.int32)
+    N = 6
+    oracle = generate(CFG, params, prompt, N, cache_dtype=jnp.float32)
+    spec = PlacementSpec.balanced(CFG.num_hidden_layers, 1)
+    res = _run_pipeline(CFG, params, spec, prompt, N)
+    np.testing.assert_array_equal(res.tokens, oracle.tokens)
+
+
+def test_batched_padded_pipeline(params):
+    """Batched + right-padded prompts through the pipeline — beyond the
+    reference's batch=1 (SURVEY.md §2 DP row)."""
+    N = 6
+    batch = np.zeros((2, 5), np.int32)
+    batch[0] = [3, 1, 4, 1, 5]
+    batch[1, :3] = [2, 7, 1]
+    plen = np.array([5, 3])
+    oracle = generate(
+        CFG, params, batch, N, prompt_len=plen, cache_dtype=jnp.float32
+    )
+    spec = PlacementSpec.balanced(CFG.num_hidden_layers, 4)
+    res = _run_pipeline(CFG, params, spec, batch, N, prompt_len=plen)
+    np.testing.assert_array_equal(res.tokens, oracle.tokens)
+
+
+def test_eight_stage_full_mesh(params):
+    """One layer per stage on all 8 virtual devices (BASELINE config #2
+    shape: 8-way layer sharding, one stage per chip)."""
+    prompt = np.array([[9, 8, 7, 6]], dtype=np.int32)
+    N = 5
+    oracle = generate(CFG, params, prompt, N, cache_dtype=jnp.float32)
+    spec = PlacementSpec.balanced(CFG.num_hidden_layers, 8)
+    res = _run_pipeline(CFG, params, spec, prompt, N)
+    np.testing.assert_array_equal(res.tokens, oracle.tokens)
+
+
+def test_gpt2_pipeline_token_exact():
+    """The second architecture flows through the same pipeline runtime."""
+    from llm_sharding_tpu.models import gpt2 as gpt2_mod
+
+    cfg = tiny_gpt2()
+    key = jax.random.key(11)
+    # random-init gpt2 params via convert-compatible shapes
+    import torch
+    from transformers import GPT2Config, GPT2LMHeadModel
+    from llm_sharding_tpu.utils.convert import params_from_hf
+
+    torch.manual_seed(5)
+    hf = GPT2LMHeadModel(
+        GPT2Config(
+            vocab_size=cfg.vocab_size,
+            n_embd=cfg.hidden_size,
+            n_layer=cfg.num_hidden_layers,
+            n_head=cfg.num_attention_heads,
+            n_positions=cfg.max_position_embeddings,
+            n_inner=cfg.intermediate_size,
+        )
+    )
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    params = params_from_hf(cfg, sd, dtype=jnp.float32)
+
+    prompt = np.array([[11, 23, 35]], dtype=np.int32)
+    N = 7
+    oracle = generate(cfg, params, prompt, N, cache_dtype=jnp.float32)
+    spec = PlacementSpec.balanced(cfg.num_hidden_layers, 4)
+    res = _run_pipeline(cfg, params, spec, prompt, N)
+    np.testing.assert_array_equal(res.tokens, oracle.tokens)
