@@ -19,6 +19,6 @@ Public surface:
     runtime.generate   -- single-host generation (oracle + serving core)
 """
 
-from . import models, ops, parallel, runtime, utils  # noqa: F401
+from . import models, ops, parallel, profiler, runtime, utils  # noqa: F401
 
 __version__ = "0.1.0"

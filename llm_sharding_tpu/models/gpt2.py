@@ -86,7 +86,10 @@ def forward_layers(
 
 def final_logits(cfg: ModelConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
     h = layer_norm(h, params["final_norm"], params["final_norm_bias"], cfg.layer_norm_epsilon)
-    return (h @ params["lm_head"]).astype(jnp.float32)
+    if "lm_head" in params:
+        return (h @ params["lm_head"]).astype(jnp.float32)
+    # GPT-2 always ties lm_head to wte — contract against the table directly.
+    return jnp.einsum("bsh,vh->bsv", h, params["embed"]).astype(jnp.float32)
 
 
 def forward(

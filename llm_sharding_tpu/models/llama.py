@@ -72,17 +72,16 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
     k_emb, k_layers, k_head = jax.random.split(key, 3)
     V, H = cfg.vocab_size, cfg.hidden_size
     embed = (jax.random.normal(k_emb, (V, H), jnp.float32) * H**-0.5).astype(dtype)
-    lm_head = (
-        embed.T
-        if cfg.tie_word_embeddings
-        else (jax.random.normal(k_head, (H, V), jnp.float32) * H**-0.5).astype(dtype)
-    )
-    return {
+    params = {
         "embed": embed,
         "layers": init_layer_params(cfg, k_layers, cfg.num_hidden_layers, dtype),
         "final_norm": jnp.ones((H,), dtype),
-        "lm_head": lm_head,
     }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = (
+            jax.random.normal(k_head, (H, V), jnp.float32) * H**-0.5
+        ).astype(dtype)
+    return params
 
 
 # ---------------------------------------------------------------------------
@@ -158,9 +157,15 @@ def forward_layers(
 
 def final_logits(cfg: ModelConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
     """Final norm + lm_head (≙ the reference's last-node role,
-    ``/root/reference/utils/node_worker.py:155-164, 260-265``)."""
+    ``/root/reference/utils/node_worker.py:155-164, 260-265``).
+
+    Tied checkpoints carry no ``lm_head`` array — the projection contracts
+    against the embedding table directly (XLA folds the transpose into the
+    matmul; no duplicate vocab×hidden buffer in HBM)."""
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
-    return (h @ params["lm_head"]).astype(jnp.float32)
+    if "lm_head" in params:
+        return (h @ params["lm_head"]).astype(jnp.float32)
+    return jnp.einsum("bsh,vh->bsv", h, params["embed"]).astype(jnp.float32)
 
 
 def forward(

@@ -1,1 +1,1 @@
-from . import mesh, pipeline, placement  # noqa: F401
+from . import mesh, pipeline, placement, schedule  # noqa: F401

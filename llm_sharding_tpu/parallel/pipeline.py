@@ -76,6 +76,53 @@ def _tree_where(pred, new, old):
     return jax.tree.map(lambda a, b: jnp.where(pred, a, b), new, old)
 
 
+def ring_chain(fns, cfg, layers, lmask, sidx, ring, num_stages, h, cache, positions):
+    """One full trip around the ring: each stage applies its layer slice on
+    its active microstep, then the block hops to the next device
+    (≙ one traversal of the reference's device chain,
+    ``node_worker.py:541-543``). Shared by the sequential pipeline and the
+    interleaved scheduler's prefill."""
+
+    def micro(m, carry):
+        h, cache = carry
+        h_new, cache_new = fns.stage(cfg, layers, h, cache, positions, lmask)
+        active = m == sidx
+        h = jnp.where(active, h_new, h)
+        cache = _tree_where(active, cache_new, cache)
+        h = jax.lax.ppermute(h, PIPE_AXIS, ring)
+        return h, cache
+
+    return jax.lax.fori_loop(0, num_stages, micro, (h, cache))
+
+
+def validate_request(
+    cfg: ModelConfig, prompt_tokens: int, max_new_tokens: int, capacity: Optional[int]
+) -> int:
+    """Host-boundary request validation shared by both pipeline schedulers
+    (see models/cache.py capacity contract). Returns the resolved capacity."""
+    total = prompt_tokens + max_new_tokens
+    capacity = capacity or total
+    if total > capacity:
+        raise ValueError(
+            f"prompt ({prompt_tokens}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds cache capacity ({capacity})"
+        )
+    if total > cfg.max_position_embeddings:
+        raise ValueError(
+            f"requested {total} positions > max_position_embeddings "
+            f"({cfg.max_position_embeddings})"
+        )
+    return capacity
+
+
+def check_stage_shapes(layer_masks, num_stages: int) -> None:
+    if layer_masks.shape[0] != num_stages:
+        raise ValueError(
+            f"stage params built for {layer_masks.shape[0]} stages but mesh "
+            f"has {num_stages} on '{PIPE_AXIS}'"
+        )
+
+
 class PipelineResult(NamedTuple):
     tokens: np.ndarray  # [B, S + max_new_tokens]
     lengths: np.ndarray  # [B]
@@ -126,21 +173,9 @@ def _pipeline_generate_jit(
         )
 
         def chain(h, cache, positions):
-            """One full trip around the ring: each stage applies its slice on
-            its active microstep, then the block hops to the next device
-            (≙ one traversal of the reference's device chain,
-            ``node_worker.py:541-543``)."""
-
-            def micro(m, carry):
-                h, cache = carry
-                h_new, cache_new = fns.stage(cfg, layers, h, cache, positions, mask)
-                active = m == sidx
-                h = jnp.where(active, h_new, h)
-                cache = _tree_where(active, cache_new, cache)
-                h = jax.lax.ppermute(h, PIPE_AXIS, ring)
-                return h, cache
-
-            return jax.lax.fori_loop(0, num_stages, micro, (h, cache))
+            return ring_chain(
+                fns, cfg, layers, mask, sidx, ring, num_stages, h, cache, positions
+            )
 
         # ---- prefill (≙ receive_user_request → chain traversal,
         # node_worker.py:188-272) ----
@@ -251,25 +286,9 @@ def pipeline_generate(
     else:
         prompt_len = jnp.asarray(prompt_len, jnp.int32)
 
-    total = S + max_new_tokens
-    capacity = capacity or total
-    if total > capacity:
-        raise ValueError(
-            f"prompt ({S}) + max_new_tokens ({max_new_tokens}) exceeds cache "
-            f"capacity ({capacity})"
-        )
-    if total > cfg.max_position_embeddings:
-        raise ValueError(
-            f"requested {total} positions > max_position_embeddings "
-            f"({cfg.max_position_embeddings})"
-        )
-
+    capacity = validate_request(cfg, S, max_new_tokens, capacity)
     num_stages = mesh.shape[PIPE_AXIS]
-    if layer_masks.shape[0] != num_stages:
-        raise ValueError(
-            f"stage params built for {layer_masks.shape[0]} stages but mesh "
-            f"has {num_stages} on '{PIPE_AXIS}'"
-        )
+    check_stage_shapes(layer_masks, num_stages)
 
     out, lengths = _pipeline_generate_jit(
         cfg,

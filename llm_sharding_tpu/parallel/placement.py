@@ -99,28 +99,34 @@ class PlacementSpec:
 
 
 def stack_stage_params(
-    spec: PlacementSpec, full_layers: dict[str, jnp.ndarray]
-) -> tuple[dict[str, jnp.ndarray], jnp.ndarray]:
+    spec: PlacementSpec, full_layers: dict[str, Any]
+) -> tuple[dict[str, np.ndarray], np.ndarray]:
     """Slice full-model stacked layers [L, ...] into per-stage padded stacks.
 
     Returns ``(stage_layers, layer_masks)`` where each ``stage_layers`` leaf is
     ``[num_stages, max_layers_per_stage, ...]`` (shard axis 0 over "pipe") and
     ``layer_masks`` is ``[num_stages, max_layers_per_stage]`` bool.
+
+    Works on HOST (numpy) arrays and returns numpy: the caller device_puts the
+    result with the mesh sharding (see ``runtime/engine.py``), so the padded
+    stack never materializes whole on a single device — only each device's
+    slice lands in its HBM.
     """
     P = spec.max_layers_per_stage
 
-    def slice_leaf(leaf: jnp.ndarray) -> jnp.ndarray:
+    def slice_leaf(leaf) -> np.ndarray:
+        leaf = np.asarray(leaf)
         parts = []
         for start, end in spec.stages:
             chunk = leaf[start:end]
             if end - start < P:
-                pad = jnp.zeros((P - (end - start), *chunk.shape[1:]), chunk.dtype)
-                chunk = jnp.concatenate([chunk, pad], axis=0)
+                pad = np.zeros((P - (end - start), *chunk.shape[1:]), chunk.dtype)
+                chunk = np.concatenate([chunk, pad], axis=0)
             parts.append(chunk)
-        return jnp.stack(parts)
+        return np.stack(parts)
 
     stage_layers = jax.tree.map(slice_leaf, full_layers)
     masks = np.zeros((spec.num_stages, P), bool)
     for i, (start, end) in enumerate(spec.stages):
         masks[i, : end - start] = True
-    return stage_layers, jnp.asarray(masks)
+    return stage_layers, masks

@@ -1,0 +1,320 @@
+"""Interleaved microbatched pipeline decode — filling the pipeline the
+reference leaves idle.
+
+The reference keeps exactly one token in flight: while a token is on stage s,
+every other stage idles (``/root/reference/utils/node_worker.py:493-547``;
+SURVEY.md §3.2 "no overlap of communication and compute anywhere"). That caps
+chain throughput at (1 token) / (S stage-times). This scheduler runs
+``num_stages`` independent requests in flight, round-robin: at every
+microstep, each device computes a *different* request's block, then the ring
+permutes — so every stage does useful work every microstep and aggregate
+throughput approaches one token per stage-time, an S× improvement that is the
+mechanism behind the ≥100 tok/s v5e-8 headline target (BASELINE.md;
+SURVEY.md §7 "hard parts": microbatched decode).
+
+Schedule (S = num_stages, request slot r, microstep m):
+- device d serves slot r = (m − d) mod S;
+- a completed token (device S−1) is immediately re-embedded there and sent to
+  stage 0 through the same ring permute that carries hidden blocks — the
+  reference's token-return hop (``node_worker.py:515-525``) fused into the
+  steady-state schedule;
+- prefill runs all S requests as one batched sequential chain traversal
+  (caches fill in a single trip), then the decode wavefront ramps in over the
+  first S microsteps (validity-masked), runs steady, and drains.
+
+Per-device KV caches hold all S slots ([Lp, S·B, C, ...]); each microstep
+touches only the served slot via dynamic slicing. EOS/done bookkeeping lives
+on the last stage and is psum-broadcast for the uniform while_loop predicate.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.cache import KVCache, POS_SENTINEL
+from ..models.config import ModelConfig
+from ..ops.sampling import is_stop as _is_stop
+from .mesh import PIPE_AXIS
+from .pipeline import check_stage_shapes, model_fns, ring_chain, validate_request
+
+
+class InterleavedResult(NamedTuple):
+    tokens: np.ndarray  # [M, S + max_new_tokens]
+    lengths: np.ndarray  # [M]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "mesh", "num_stages", "max_new_tokens", "capacity", "cache_dtype"
+    ),
+)
+def _interleaved_jit(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    stage_layers: Any,
+    layer_masks: jnp.ndarray,
+    head_params: Any,
+    prompts: jnp.ndarray,  # [M, S] right-padded, M == num_stages slots
+    prompt_len: jnp.ndarray,  # [M]
+    slot_valid: jnp.ndarray,  # [M] bool — False for padding slots
+    num_stages: int,
+    max_new_tokens: int,
+    capacity: int,
+    cache_dtype,
+):
+    fns = model_fns(cfg)
+    M, S = prompts.shape
+    total = S + max_new_tokens
+    Lp = layer_masks.shape[1]
+    ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+    last = num_stages - 1
+
+    def body(stage_layers, layer_mask, head_params, prompts, prompt_len, slot_valid):
+        layers = jax.tree.map(lambda a: a[0], stage_layers)
+        lmask = layer_mask[0]
+        sidx = jax.lax.axis_index(PIPE_AXIS)
+
+        # ---- batched prefill: all M requests in one chain traversal ----
+        cache = KVCache(
+            k=jnp.zeros((Lp, M, capacity, cfg.num_key_value_heads, cfg.head_dim_), cache_dtype),
+            v=jnp.zeros((Lp, M, capacity, cfg.num_key_value_heads, cfg.head_dim_), cache_dtype),
+            pos=jnp.full((M, capacity), POS_SENTINEL, jnp.int32),
+            length=jnp.zeros((), jnp.int32),
+        )
+        idx = jnp.arange(S, dtype=jnp.int32)
+        positions = jnp.where(
+            idx[None, :] < prompt_len[:, None], idx[None, :], POS_SENTINEL
+        )
+        h = fns.embed(head_params, prompts, positions)
+        h, cache = ring_chain(
+            fns, cfg, layers, lmask, sidx, ring, num_stages, h, cache, positions
+        )
+        # full-depth block landed on stage 0
+        logits = fns.logits(cfg, head_params, h)
+        first_last = jnp.take_along_axis(
+            logits, (prompt_len - 1)[:, None, None], axis=1
+        )[:, 0]
+        tok0 = jnp.argmax(first_last, axis=-1).astype(jnp.int32)  # [M], valid @ stage 0
+
+        # Every stage needs tok0 (stage 0 injects from it during ramp-in) and
+        # the out/done bookkeeping starts from it on the LAST stage.
+        tok0 = jax.lax.psum(jnp.where(sidx == 0, tok0, 0), PIPE_AXIS)
+
+        out = jnp.zeros((M, total), jnp.int32)
+        out = jax.lax.dynamic_update_slice(out, prompts, (0, 0))
+        out = out.at[jnp.arange(M), prompt_len].set(
+            jnp.where(slot_valid, tok0, 0)
+        )
+        done0 = (_is_stop(cfg, tok0) | ~slot_valid)
+        lengths = jnp.where(slot_valid, prompt_len + 1, prompt_len)
+
+        # ---- interleaved decode ----
+        # Per-device per-slot position of the slot's current token.
+        pos_slots = prompt_len  # [M]
+
+        # decode cache: after prefill, cache.length == S (shared write offset);
+        # slot writes now advance independently per serve via per-slot offset.
+        # We carry a per-slot write offset ([M]) starting at S.
+        write_off = jnp.full((M,), S, jnp.int32)
+
+        # tok0 (from prefill) is generated token #1; each slot needs
+        # max_new_tokens - 1 more completions, one per ring cycle. Slot r's
+        # last completion happens at microstep r + (S-1) + (max_new-2)·S, so
+        # the drain needs S·max_new − 1 microsteps for the last slot.
+        total_micro = num_stages * max_new_tokens - 1
+
+        # The resident activation per device is ONE request's single-token
+        # block; stage 0 injects the first real one during ramp-in.
+        state = dict(
+            h=jnp.zeros((1, 1, cfg.hidden_size), h.dtype),
+            cache=cache,
+            out=out,
+            done=done0,
+            lengths=lengths,
+            pos_slots=pos_slots,
+            write_off=write_off,
+            tok0=tok0,
+            m=jnp.zeros((), jnp.int32),
+        )
+
+        def cond(s):
+            return (s["m"] < total_micro) & ~jnp.all(s["done"])
+
+        def micro(s):
+            m = s["m"]
+            r = jnp.mod(m - sidx, num_stages)  # slot this device serves
+            ramp_in = m < num_stages  # wavefront not yet arrived everywhere
+            valid = m >= sidx  # device has real data from m == sidx onward
+
+            pos_r = jax.lax.dynamic_index_in_dim(s["pos_slots"], r, keepdims=False)
+            off_r = jax.lax.dynamic_index_in_dim(s["write_off"], r, keepdims=False)
+
+            # stage 0 self-injects the slot's first decode embedding during
+            # ramp-in (token tok0[r] at position pos_r)
+            tok_r = jax.lax.dynamic_index_in_dim(s["tok0"], r, keepdims=False)
+            inject = fns.embed(
+                head_params, tok_r[None, None], pos_r[None, None]
+            )
+            h_in = jnp.where((sidx == 0) & ramp_in, inject, s["h"])
+
+            # slice this slot's cache rows
+            cache_r = KVCache(
+                k=jax.lax.dynamic_slice_in_dim(s["cache"].k, r, 1, axis=1),
+                v=jax.lax.dynamic_slice_in_dim(s["cache"].v, r, 1, axis=1),
+                pos=jax.lax.dynamic_slice_in_dim(s["cache"].pos, r, 1, axis=0),
+                length=off_r,
+            )
+            h_new, cache_r_new = fns.stage(
+                cfg, layers, h_in, cache_r, pos_r[None, None], lmask
+            )
+            # Commit the slot cache UNCONDITIONALLY — a ramp-in garbage write
+            # lands at the same offset the first valid serve will overwrite
+            # (write_off only advances on valid serves), and nothing reads the
+            # slot in between. This avoids a full-cache select per microstep.
+            def upd(big, small, axis):
+                return jax.lax.dynamic_update_slice_in_dim(big, small, r, axis=axis)
+
+            cache = KVCache(
+                k=upd(s["cache"].k, cache_r_new.k, 1),
+                v=upd(s["cache"].v, cache_r_new.v, 1),
+                pos=upd(s["cache"].pos, cache_r_new.pos, 0),
+                length=s["cache"].length,
+            )
+            write_off = jnp.where(
+                valid, s["write_off"].at[r].add(1), s["write_off"]
+            )
+
+            # last stage: complete the token
+            logits = fns.logits(cfg, head_params, h_new)[:, 0]  # [1, V]
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+            done_r = jax.lax.dynamic_index_in_dim(s["done"], r, keepdims=False)
+            nxt = jnp.where(done_r, 0, nxt)
+
+            is_last = sidx == last
+            len_r = jax.lax.dynamic_index_in_dim(s["lengths"], r, keepdims=False)
+            plen_r = jax.lax.dynamic_index_in_dim(prompt_len, r, keepdims=False)
+            under_budget = (len_r - plen_r) < max_new_tokens
+            commit_tok = is_last & valid & ~done_r & under_budget
+            out = jnp.where(
+                commit_tok,
+                s["out"].at[r, pos_r + 1].set(nxt),
+                s["out"],
+            )
+            lengths = jnp.where(
+                commit_tok, s["lengths"].at[r].add(1), s["lengths"]
+            )
+            newly_done = commit_tok & _is_stop(cfg, nxt[None])[0]
+            done = jnp.where(newly_done, s["done"].at[r].set(True), s["done"])
+            # broadcast done from the last stage for a uniform predicate
+            done = (
+                jax.lax.psum(
+                    jnp.where(sidx == last, done.astype(jnp.int32), 0), PIPE_AXIS
+                )
+                > 0
+            )
+
+            # last stage re-embeds its freshly-made token for the ring
+            h_send = jnp.where(
+                is_last,
+                fns.embed(head_params, nxt[None, None], (pos_r + 1)[None, None]),
+                h_new,
+            )
+            h_out = jax.lax.ppermute(h_send, PIPE_AXIS, ring)
+
+            # this device will see slot r again in S microsteps, one token deeper
+            pos_slots = jnp.where(valid, s["pos_slots"].at[r].add(1), s["pos_slots"])
+
+            return dict(
+                h=h_out,
+                cache=cache,
+                out=out,
+                done=done,
+                lengths=lengths,
+                pos_slots=pos_slots,
+                write_off=write_off,
+                tok0=s["tok0"],
+                m=m + 1,
+            )
+
+        state = jax.lax.while_loop(cond, micro, state)
+
+        def bcast_last(x):
+            return jax.lax.psum(
+                jnp.where(sidx == last, x, jnp.zeros_like(x)), PIPE_AXIS
+            )
+
+        return bcast_last(state["out"]), bcast_last(state["lengths"])
+
+    out, lengths = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(PIPE_AXIS), P(PIPE_AXIS), P(), P(), P(), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )(stage_layers, layer_masks, head_params, prompts, prompt_len, slot_valid)
+    return out, lengths
+
+
+def interleaved_generate(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    stage_layers: Any,
+    layer_masks: jnp.ndarray,
+    head_params: Any,
+    prompts,  # [M, S] with M <= num_stages (padded to num_stages slots)
+    max_new_tokens: int = 128,
+    *,
+    prompt_len=None,
+    capacity: Optional[int] = None,
+    cache_dtype=jnp.bfloat16,
+) -> InterleavedResult:
+    """Generate for up to ``num_stages`` requests concurrently, pipeline full."""
+    prompts = jnp.asarray(prompts, jnp.int32)
+    if prompts.ndim == 1:
+        prompts = prompts[None]
+    M, S = prompts.shape
+    num_stages = mesh.shape[PIPE_AXIS]
+    if M > num_stages:
+        raise ValueError(
+            f"{M} requests > {num_stages} pipeline slots; batch into groups "
+            f"of {num_stages}"
+        )
+    if prompt_len is None:
+        prompt_len = jnp.full((M,), S, jnp.int32)
+    else:
+        prompt_len = jnp.asarray(prompt_len, jnp.int32)
+
+    capacity = validate_request(cfg, S, max_new_tokens, capacity)
+    check_stage_shapes(layer_masks, num_stages)
+
+    slot_valid = np.zeros((num_stages,), bool)
+    slot_valid[:M] = True
+    if M < num_stages:  # pad slots with dummy single-token prompts
+        pad = np.zeros((num_stages - M, S), np.int32)
+        prompts = jnp.concatenate([prompts, jnp.asarray(pad)], axis=0)
+        prompt_len = jnp.concatenate(
+            [prompt_len, jnp.ones((num_stages - M,), jnp.int32)], axis=0
+        )
+
+    out, lengths = _interleaved_jit(
+        cfg,
+        mesh,
+        stage_layers,
+        layer_masks,
+        head_params,
+        prompts,
+        prompt_len,
+        jnp.asarray(slot_valid),
+        num_stages,
+        max_new_tokens,
+        capacity,
+        cache_dtype,
+    )
+    return InterleavedResult(np.asarray(out)[:M], np.asarray(lengths)[:M])

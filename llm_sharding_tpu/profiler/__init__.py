@@ -1,0 +1,2 @@
+from . import profiler  # noqa: F401
+from .profiler import Profiler, fit_latency_models, max_layers_fit  # noqa: F401

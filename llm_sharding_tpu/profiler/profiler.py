@@ -1,0 +1,436 @@
+"""Capability profiler — TPU-native rebuild of the reference's largest subsystem.
+
+The reference's ``NodeProfiler`` (``/root/reference/utils/node_profiler.py``,
+1340 LoC, 53% of the repo) measures each device's prefill/decode compute
+capability and fits latency models for the placement scheduler. This module
+reproduces every measured product in TPU form:
+
+- prefill latency sweep over prompt lengths with warm-up and repeats
+  (≙ ``profile_compute_capability``, ``node_profiler.py:822-979``; sweep
+  envelope {8..512}×3 with cool-down, ``:14-17``)
+- per-token capability ``c_k`` in sec/(token·layer), normalized by loaded
+  layer count (≙ ``:368-407``, normalization ``:377``)
+- decode cumulative-latency curve (≙ ``:409-476``)
+- linear + quadratic least-squares latency models with RMSE/R²
+  (≙ ``_fit_latency_models``, ``:64-204`` — ``torch.linalg.lstsq`` →
+  ``np.linalg.lstsq``)
+- prefill≈decode similarity verdict at a 30% threshold (≙ ``:206-298``)
+- cold-start shard-load latency, total + per layer (≙ ``:1138-1172``)
+- max loadable layer count — by HBM accounting instead of crashing into OOM
+  (≙ ``profile_max_layer_num``, ``:46-62``)
+- stage-level profiling with fed-in activations — subsumes "assisted"
+  profiling (``:981-1136``): the reference needs a second device to host the
+  complement of a too-big model; here any layer range runs standalone against
+  synthetic hidden states, so no assistor process is needed.
+
+Timing discipline: ``block_until_ready`` around ``time.perf_counter`` is the
+XLA analogue of the reference's ``torch.cuda.synchronize`` bracketing
+(``:300-308`` — async dispatch would otherwise measure submission, not
+execution), and warm-up runs double as compile amortization (``:860-878``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..models import llama
+from ..models.cache import init_cache
+from ..models.config import ModelConfig
+from ..runtime.generate import forward_fn_for
+
+DEFAULT_PREFILL_LENGTHS = (8, 16, 32, 64, 128, 256, 512)  # ≙ node_profiler.py:14-17
+DEFAULT_REPEATS = 3
+SIMILARITY_THRESHOLD = 0.30  # ≙ node_profiler.py:212
+
+
+# ---------------------------------------------------------------------------
+# Latency-model fitting (≙ _fit_latency_models, node_profiler.py:64-204)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class LatencyFit:
+    kind: str  # "linear" | "quadratic"
+    coeffs: tuple  # highest-order first: (a, b) for aS+b; (a, b, c) for aS²+bS+c
+    rmse: float
+    r2: float
+
+    def predict(self, x) -> np.ndarray:
+        return np.polyval(np.asarray(self.coeffs), np.asarray(x, np.float64))
+
+
+def fit_latency_models(x: Sequence[float], y: Sequence[float]) -> dict[str, LatencyFit]:
+    """Least-squares linear T(S)=aS+b and quadratic T(S)=aS²+bS+c fits with
+    RMSE and R² (≙ node_profiler.py:89-139)."""
+    x = np.asarray(x, np.float64)
+    y = np.asarray(y, np.float64)
+    out = {}
+    for kind, deg in (("linear", 1), ("quadratic", 2)):
+        if len(x) < deg + 1:
+            continue  # underdetermined — skip rather than warn/overfit
+        coeffs = np.polyfit(x, y, deg)
+        pred = np.polyval(coeffs, x)
+        resid = y - pred
+        rmse = float(np.sqrt(np.mean(resid**2)))
+        ss_tot = float(np.sum((y - y.mean()) ** 2))
+        r2 = 1.0 - float(np.sum(resid**2)) / ss_tot if ss_tot > 0 else 1.0
+        out[kind] = LatencyFit(kind, tuple(float(c) for c in coeffs), rmse, r2)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Reports
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class PrefillReport:
+    lengths: tuple  # prompt token lengths measured
+    latencies_s: tuple  # median-of-repeats wall seconds per length
+    capability_c_k: float  # sec per (token · full-model-layer), ≙ :384-395
+    fits: dict  # {"linear": LatencyFit, "quadratic": LatencyFit}
+    num_layers_measured: int
+    num_layers_model: int
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeReport:
+    token_counts: tuple  # cumulative output-token counts
+    cumulative_s: tuple  # cumulative latency at each count
+    capability_c_k: float  # sec per (token · layer), from mean marginal cost
+    fits: dict
+
+
+@dataclasses.dataclass(frozen=True)
+class SimilarityVerdict:
+    """≙ _report_prefill_decode_similarity, node_profiler.py:206-298."""
+
+    avg_ratio: float  # mean decode/prefill per-token cost ratio
+    slope_ratio: float  # linear-slope ratio
+    quadratic_marginal_ratio: float  # 2aS+b marginal-cost ratio at mid-sweep
+    similar: bool  # all ratios within threshold of 1.0
+    threshold: float
+
+
+@dataclasses.dataclass(frozen=True)
+class ColdStartReport:
+    total_s: float
+    per_layer_s: tuple
+    num_layers: int
+
+
+# ---------------------------------------------------------------------------
+# Profiler
+# ---------------------------------------------------------------------------
+
+def _timeit(fn: Callable[[], Any]) -> float:
+    t0 = time.perf_counter()
+    out = fn()
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0
+
+
+class Profiler:
+    """Per-device capability measurement of compiled model steps.
+
+    ``params`` may be a full-model pytree or a layer slice; ``num_layers``
+    actually held is detected from the params, and capabilities are
+    normalized to full-model-layer units exactly like the reference
+    (``layer_num/loaded_layer_num`` scaling, node_profiler.py:377, 426-430).
+    """
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: Any,
+        *,
+        dtype=jnp.bfloat16,
+        cooldown_s: float = 0.0,
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.dtype = dtype
+        self.cooldown_s = cooldown_s
+        self.num_layers_held = int(
+            jax.tree.leaves(params["layers"])[0].shape[0]
+        )
+
+    # -- prefill ------------------------------------------------------------
+
+    def profile_prefill(
+        self,
+        lengths: Sequence[int] = DEFAULT_PREFILL_LENGTHS,
+        repeats: int = DEFAULT_REPEATS,
+        batch_size: int = 1,
+    ) -> PrefillReport:
+        cfg = self.cfg
+        lengths = tuple(
+            s for s in lengths if s <= cfg.max_position_embeddings
+        )  # ≙ the max_position_embeddings guard, node_profiler.py:352
+        fwd = forward_fn_for(cfg)
+        step = jax.jit(
+            lambda p, ids, c, pos: fwd(cfg, p, ids, c, pos)[0]
+        )
+
+        def run(S: int) -> float:
+            ids = jnp.zeros((batch_size, S), jnp.int32)
+            pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (batch_size, S))
+            cache = init_cache(
+                cfg, batch_size, S, num_layers=self.num_layers_held, dtype=self.dtype
+            )
+            return _timeit(lambda: step(self.params, ids, cache, pos))
+
+        # Warm-up longest then shortest (first-measurement outlier avoidance,
+        # ≙ node_profiler.py:860-878) — also compiles each length's program.
+        for S in (max(lengths), min(lengths)):
+            run(S)
+        for S in lengths:
+            run(S)  # compile any remaining shapes outside timed region
+
+        med = []
+        for S in lengths:
+            samples = []
+            for _ in range(repeats):
+                samples.append(run(S))
+                if self.cooldown_s:
+                    time.sleep(self.cooldown_s)
+            med.append(float(np.median(samples)))
+
+        # capability: sec per token per full-model layer, normalized for
+        # partial loads (≙ :377, :384-395)
+        scale = self.cfg.num_hidden_layers / self.num_layers_held
+        per_token = [t * scale / s for t, s in zip(med, lengths)]
+        c_k = float(np.mean(per_token)) / self.cfg.num_hidden_layers
+
+        return PrefillReport(
+            lengths=lengths,
+            latencies_s=tuple(med),
+            capability_c_k=c_k,
+            fits=fit_latency_models(lengths, med),
+            num_layers_measured=self.num_layers_held,
+            num_layers_model=self.cfg.num_hidden_layers,
+        )
+
+    # -- decode -------------------------------------------------------------
+
+    def profile_decode(
+        self,
+        max_tokens: int = 64,
+        prompt_len: int = 8,
+        batch_size: int = 1,
+        measure_every: int = 8,
+    ) -> DecodeReport:
+        """Cumulative decode latency vs output-token count
+        (≙ node_profiler.py:927-966). Requires the full model held
+        (≙ the guard at :912-918) since decode needs logits."""
+        if self.num_layers_held != self.cfg.num_hidden_layers:
+            raise ValueError(
+                "decode profiling needs the full model on this device "
+                f"(holding {self.num_layers_held}/{self.cfg.num_hidden_layers} "
+                "layers); profile the stage with profile_stage instead"
+            )
+        cfg = self.cfg
+        fwd = forward_fn_for(cfg)
+        capacity = prompt_len + max_tokens
+        step = jax.jit(lambda p, ids, c, pos: fwd(cfg, p, ids, c, pos))
+
+        ids = jnp.zeros((batch_size, prompt_len), jnp.int32)
+        pos = jnp.broadcast_to(
+            jnp.arange(prompt_len, dtype=jnp.int32), (batch_size, prompt_len)
+        )
+        cache = init_cache(cfg, batch_size, capacity, dtype=self.dtype)
+        logits, cache = step(self.params, ids, cache, pos)
+        jax.block_until_ready(logits)
+        # warm-up one decode step shape
+        tok = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+        warm_cache = cache
+        _, warm_cache = step(
+            self.params, tok, warm_cache, jnp.full((batch_size, 1), prompt_len, jnp.int32)
+        )
+        jax.block_until_ready(warm_cache.k)
+
+        counts, cums = [], []
+        t_start = time.perf_counter()
+        cur = tok
+        for t in range(max_tokens):
+            logits, cache = step(
+                self.params, cur, cache, jnp.full((batch_size, 1), prompt_len + t, jnp.int32)
+            )
+            cur = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+            if (t + 1) % measure_every == 0 or t == max_tokens - 1:
+                jax.block_until_ready(cur)
+                counts.append(t + 1)
+                cums.append(time.perf_counter() - t_start)
+
+        marginal = np.diff([0.0] + cums) / np.diff([0] + counts)
+        c_k = float(np.mean(marginal)) / cfg.num_hidden_layers
+
+        return DecodeReport(
+            token_counts=tuple(counts),
+            cumulative_s=tuple(cums),
+            capability_c_k=c_k,
+            fits=fit_latency_models(counts, cums),
+        )
+
+    # -- stage profiling (assisted-mode equivalent) -------------------------
+
+    def profile_stage(
+        self,
+        seq_len: int,
+        batch_size: int = 1,
+        repeats: int = DEFAULT_REPEATS,
+        layer_mask: Optional[jnp.ndarray] = None,
+    ) -> float:
+        """Median latency of this params slice on synthetic activations.
+
+        Subsumes the reference's assisted profiling
+        (``node_profiler.py:981-1136``): a stage too small to hold the whole
+        model is timed against fed-in hidden states — no assistor device.
+        Returns median seconds for one pass of the held layers.
+        """
+        cfg = self.cfg
+        from ..parallel.pipeline import model_fns
+
+        fns = model_fns(cfg)
+        step = jax.jit(
+            lambda layers, h, c, pos: fns.stage(cfg, layers, h, c, pos, layer_mask)[0]
+        )
+        h = jnp.zeros((batch_size, seq_len, cfg.hidden_size), self.dtype)
+        pos = jnp.broadcast_to(
+            jnp.arange(seq_len, dtype=jnp.int32), (batch_size, seq_len)
+        )
+        cache = init_cache(
+            cfg, batch_size, seq_len, num_layers=self.num_layers_held, dtype=self.dtype
+        )
+        _timeit(lambda: step(self.params["layers"], h, cache, pos))  # compile
+        samples = [
+            _timeit(lambda: step(self.params["layers"], h, cache, pos))
+            for _ in range(repeats)
+        ]
+        return float(np.median(samples))
+
+    # -- similarity verdict -------------------------------------------------
+
+    @staticmethod
+    def similarity_verdict(
+        prefill: PrefillReport,
+        decode: DecodeReport,
+        threshold: float = SIMILARITY_THRESHOLD,
+    ) -> SimilarityVerdict:
+        avg_ratio = decode.capability_c_k / prefill.capability_c_k
+        slope_ratio = (
+            decode.fits["linear"].coeffs[0] / prefill.fits["linear"].coeffs[0]
+        )
+        # marginal cost 2aS+b of the quadratic fits at mid-sweep (≙ :278-298);
+        # quadratic fits exist only with >= 3 sample points
+        ratios = [avg_ratio, slope_ratio]
+        quad_ratio = float("nan")
+        if "quadratic" in prefill.fits and "quadratic" in decode.fits:
+            s_mid = float(np.mean(prefill.lengths))
+            aq_p, bq_p, _ = prefill.fits["quadratic"].coeffs
+            aq_d, bq_d, _ = decode.fits["quadratic"].coeffs
+            t_mid = float(np.mean(decode.token_counts))
+            marg_p = 2 * aq_p * s_mid + bq_p
+            marg_d = 2 * aq_d * t_mid + bq_d
+            quad_ratio = marg_d / marg_p if marg_p else float("inf")
+            ratios.append(quad_ratio)
+        similar = all(abs(r - 1.0) <= threshold for r in ratios)
+        return SimilarityVerdict(
+            avg_ratio=float(avg_ratio),
+            slope_ratio=float(slope_ratio),
+            quadratic_marginal_ratio=float(quad_ratio),
+            similar=similar,
+            threshold=threshold,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Memory fit + cold start (standalone helpers)
+# ---------------------------------------------------------------------------
+
+def layer_param_bytes(cfg: ModelConfig, dtype=jnp.bfloat16) -> int:
+    """Exact per-decoder-layer parameter bytes from the config."""
+    H, I, D = cfg.hidden_size, cfg.intermediate_size, cfg.head_dim_
+    Nh, Nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+    if cfg.model_type == "llama":
+        n = (
+            2 * H  # norms
+            + H * Nh * D + 2 * H * Nkv * D + Nh * D * H  # attention
+            + 3 * H * I  # mlp
+        )
+    else:  # gpt2
+        n = 4 * H + H * 3 * H + 3 * H + H * H + H + 2 * H * I + I + H
+    return n * jnp.dtype(dtype).itemsize
+
+
+def kv_cache_bytes_per_layer(
+    cfg: ModelConfig, batch_size: int, capacity: int, dtype=jnp.bfloat16
+) -> int:
+    return (
+        2 * batch_size * capacity * cfg.num_key_value_heads * cfg.head_dim_
+        * jnp.dtype(dtype).itemsize
+    )
+
+
+def max_layers_fit(
+    cfg: ModelConfig,
+    *,
+    batch_size: int = 1,
+    kv_capacity: int = 4096,
+    param_dtype=jnp.bfloat16,
+    cache_dtype=jnp.bfloat16,
+    device=None,
+    hbm_bytes: Optional[int] = None,
+    reserve_fraction: float = 0.10,
+    with_head: bool = True,
+) -> int:
+    """Max decoder layers that fit device memory — by accounting, not by
+    crashing into OOM like the reference (``node_profiler.py:46-62``), which
+    probes load-until-CUDA-OOM and reserves one layer's worth for KV
+    (``:326``).
+    """
+    if hbm_bytes is None:
+        device = device or jax.devices()[0]
+        stats = getattr(device, "memory_stats", lambda: None)()
+        if stats and "bytes_limit" in stats:
+            hbm_bytes = stats["bytes_limit"]
+        else:
+            hbm_bytes = 16 * 1024**3  # v5e default; overridable
+    budget = int(hbm_bytes * (1.0 - reserve_fraction))
+    if with_head:
+        itemsize = jnp.dtype(param_dtype).itemsize
+        budget -= cfg.vocab_size * cfg.hidden_size * itemsize * 2  # embed+head
+        budget -= cfg.hidden_size * itemsize
+    per_layer = layer_param_bytes(cfg, param_dtype) + kv_cache_bytes_per_layer(
+        cfg, batch_size, kv_capacity, cache_dtype
+    )
+    return max(0, min(cfg.num_hidden_layers, budget // per_layer))
+
+
+def profile_cold_start(
+    shards_dir: str, start: int = 0, end: Optional[int] = None, dtype=jnp.bfloat16
+) -> ColdStartReport:
+    """Shard-load latency, total and per layer (≙ ``profile_cold_start_latency``,
+    ``node_profiler.py:1138-1172``)."""
+    import os
+
+    from ..utils import shard_store
+
+    cfg = shard_store.load_config(shards_dir)
+    end = end if end is not None else cfg.num_hidden_layers
+    per_layer = []
+    t_total0 = time.perf_counter()
+    for i in range(start, end):
+        t0 = time.perf_counter()
+        with np.load(os.path.join(shards_dir, f"block_{i}.npz")) as z:
+            arrs = {k: jnp.asarray(z[k], dtype) for k in z.files}
+        jax.block_until_ready(arrs)
+        per_layer.append(time.perf_counter() - t0)
+    total = time.perf_counter() - t_total0
+    return ColdStartReport(
+        total_s=total, per_layer_s=tuple(per_layer), num_layers=end - start
+    )

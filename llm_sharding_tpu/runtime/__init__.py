@@ -1,1 +1,1 @@
-from . import generate  # noqa: F401
+from . import engine, generate  # noqa: F401

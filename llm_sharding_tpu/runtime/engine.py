@@ -1,0 +1,281 @@
+"""Pipeline serving engine — the control plane + node runtime, TPU-native.
+
+Replaces the reference's master/controller pair: ``ConfigSender`` pushing
+6-key JSON configs to per-device ``NodeController`` processes
+(``/root/reference/utils/config_sender.py:4-47``,
+``utils/node_worker.py:385-559``). Here one host process owns the mesh; a
+``PlacementSpec`` plays the role of the pushed config, and "applying" it
+builds the sharded stage arrays. Capabilities preserved:
+
+- **Hot reconfiguration** (≙ ``check_new_config`` rebinding sockets and
+  reloading layer ranges in place, ``node_worker.py:445-474``):
+  ``apply_placement`` re-slices stage params at any time. Because stage
+  arrays are padded to ``max_layers_per_stage`` and the pipeline program is
+  compiled per (num_stages, padded-layer-count, batch, lengths) shape key,
+  a repartition that keeps those static shapes REUSES the compiled program —
+  only device arrays move. This is the answer to SURVEY.md §7's "hot
+  reconfiguration vs compilation" hard part; a changed stage count or pad
+  size recompiles exactly once (jit cache keyed on shapes).
+- **Between-request state clear** (≙ the clear-KV ring protocol,
+  ``node_worker.py:319-382, 507-513``): caches are allocated inside each
+  compiled request program, so every request starts clean by construction —
+  the ring-propagated origin-marking trick is unnecessary when one host owns
+  all chips (SURVEY.md §7 step 6).
+- **Request-edge privacy** (≙ embedding-before-transport,
+  ``node_worker.py:215-223`` and README privacy note): ``embed_prompt`` lets
+  a caller turn token ids into hidden states host-side; raw ids never need to
+  touch the serving path (``submit_embedding`` is the stage-0 injection
+  point, ≙ ``_forward_request``/``receive_request``,
+  ``node_worker.py:476-491``).
+- **Streaming detokenized output** (≙ the streamed ``tokenizer.decode``
+  prints, ``node_worker.py:286-298``): ``generate_text_stream`` yields text
+  deltas.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Iterator, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..models.config import ModelConfig
+from ..parallel.mesh import PIPE_AXIS, pipeline_mesh
+from ..parallel.pipeline import PipelineResult, model_fns, pipeline_generate
+from ..parallel.placement import PlacementSpec, stack_stage_params
+from ..utils import shard_store
+from .generate import generate
+
+
+class PipelineEngine:
+    """One engine per model per mesh. Thread-safe for placement swaps."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: Any,  # full params pytree (use .from_shards to load from disk)
+        *,
+        num_stages: Optional[int] = None,
+        placement: Optional[PlacementSpec] = None,
+        devices: Optional[list] = None,
+        tokenizer: Any = None,
+        cache_dtype=jnp.bfloat16,
+    ):
+        self.cfg = cfg
+        # The repartition source stays on HOST (numpy): only each device's
+        # stage slice ever lands in HBM — the whole point of pipelining a
+        # model bigger than one chip. np.asarray on bf16 jnp arrays is a
+        # zero-copy-ish host pull via ml_dtypes.
+        self._full_layers = jax.tree.map(np.asarray, params["layers"])
+        self._head_host = {
+            k: np.asarray(v) for k, v in params.items() if k != "layers"
+        }
+        self.tokenizer = tokenizer
+        self.cache_dtype = cache_dtype
+        self._lock = threading.Lock()
+
+        if placement is None:
+            n = num_stages or len(devices or jax.devices())
+            placement = PlacementSpec.balanced(cfg.num_hidden_layers, n)
+        self.mesh = pipeline_mesh(placement.num_stages, devices)
+        self._devices = devices
+        self.apply_placement(placement)
+
+    # -- construction helpers ------------------------------------------------
+
+    @classmethod
+    def from_shards(
+        cls,
+        shards_dir: str,
+        *,
+        num_stages: Optional[int] = None,
+        placement: Optional[PlacementSpec] = None,
+        devices: Optional[list] = None,
+        dtype=jnp.bfloat16,
+        cache_dtype=jnp.bfloat16,
+    ) -> "PipelineEngine":
+        """Load from a shard store (≙ NodeController startup: receive config
+        → load_shards, ``node_worker.py:403-421``)."""
+        cfg, params = shard_store.load_full(shards_dir, dtype=dtype)
+        tokenizer = None
+        if any(f.startswith("tokenizer") for f in os.listdir(shards_dir)):
+            try:
+                from transformers import AutoTokenizer
+
+                tokenizer = AutoTokenizer.from_pretrained(shards_dir)
+            except Exception:
+                tokenizer = None
+        return cls(
+            cfg,
+            params,
+            num_stages=num_stages,
+            placement=placement,
+            devices=devices,
+            tokenizer=tokenizer,
+            cache_dtype=cache_dtype,
+        )
+
+    # -- control plane (≙ ConfigSender.send_config / check_new_config) ------
+
+    def apply_placement(self, spec: PlacementSpec) -> None:
+        """Hot-apply a new layer→stage mapping (≙ ``check_new_config``,
+        ``node_worker.py:445-474``). Safe mid-service: in-flight requests
+        finish on the old arrays; new requests see the new placement."""
+        if spec.num_layers != self.cfg.num_hidden_layers:
+            raise ValueError(
+                f"placement covers {spec.num_layers} layers but model has "
+                f"{self.cfg.num_hidden_layers}"
+            )
+        if spec.num_stages != self.mesh.shape[PIPE_AXIS]:
+            # stage-count change needs a new mesh (≙ worker recreation when
+            # the role bit flips, node_worker.py:455-466)
+            mesh = pipeline_mesh(spec.num_stages, self._devices)
+        else:
+            mesh = self.mesh
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        stage_np, masks_np = stack_stage_params(spec, self._full_layers)
+        pipe_shard = NamedSharding(mesh, P(PIPE_AXIS))  # axis 0 → stages
+        repl = NamedSharding(mesh, P())
+        stage_layers = jax.tree.map(
+            lambda a: jax.device_put(a, pipe_shard), stage_np
+        )
+        masks = jax.device_put(masks_np, pipe_shard)
+        head_params = {
+            k: jax.device_put(v, repl) for k, v in self._head_host.items()
+        }
+        # Swap everything atomically — a concurrent generate sees either the
+        # old (mesh, arrays) tuple or the new one, never a mix.
+        with self._lock:
+            self.mesh = mesh
+            self.placement = spec
+            self.stage_layers = stage_layers
+            self.layer_masks = masks
+            self.head_params = head_params
+
+    # -- serving ------------------------------------------------------------
+
+    def generate_ids(
+        self,
+        prompt_ids,
+        max_new_tokens: int = 128,
+        *,
+        prompt_len=None,
+        capacity: Optional[int] = None,
+    ) -> PipelineResult:
+        with self._lock:
+            stage_layers, masks = self.stage_layers, self.layer_masks
+            mesh, head = self.mesh, self.head_params
+        return pipeline_generate(
+            self.cfg,
+            mesh,
+            stage_layers,
+            masks,
+            head,
+            prompt_ids,
+            max_new_tokens,
+            prompt_len=prompt_len,
+            capacity=capacity,
+            cache_dtype=self.cache_dtype,
+        )
+
+    def generate_many(
+        self,
+        prompts,  # [M, S] right-padded, M <= num_stages
+        max_new_tokens: int = 128,
+        *,
+        prompt_len=None,
+        capacity: Optional[int] = None,
+    ):
+        """Serve up to ``num_stages`` requests concurrently with the
+        interleaved schedule — all stages busy every microstep (the
+        throughput mode; see parallel/schedule.py)."""
+        from ..parallel.schedule import interleaved_generate
+
+        with self._lock:
+            stage_layers, masks = self.stage_layers, self.layer_masks
+            mesh, head = self.mesh, self.head_params
+        return interleaved_generate(
+            self.cfg,
+            mesh,
+            stage_layers,
+            masks,
+            head,
+            prompts,
+            max_new_tokens,
+            prompt_len=prompt_len,
+            capacity=capacity,
+            cache_dtype=self.cache_dtype,
+        )
+
+    def generate_text(self, prompt: str, max_new_tokens: int = 128) -> str:
+        tok = self._require_tokenizer()
+        ids = np.asarray(tok(prompt)["input_ids"], np.int32)[None]
+        res = self.generate_ids(ids, max_new_tokens)
+        out_ids = res.tokens[0, ids.shape[1] : int(res.lengths[0])]
+        return tok.decode(out_ids, skip_special_tokens=True)
+
+    def generate_text_stream(
+        self, prompt: str, max_new_tokens: int = 128
+    ) -> Iterator[str]:
+        """Streaming text deltas (≙ node_worker.py:286-298). Uses the
+        single-host decode path per-token for low first-token latency."""
+        tok = self._require_tokenizer()
+        from .generate import generate_stream
+
+        ids = np.asarray(tok(prompt)["input_ids"], np.int32)
+        params = {**self.head_params, "layers": self._full_layers}
+        prev = ""
+        acc: list[int] = []
+        for t in generate_stream(
+            self.cfg, params, ids, max_new_tokens, cache_dtype=self.cache_dtype
+        ):
+            acc.append(t)
+            text = tok.decode(acc, skip_special_tokens=True)
+            if len(text) > len(prev) and not text.endswith("�"):
+                yield text[len(prev):]
+                prev = text
+
+    # -- request edge / privacy (≙ embedding-before-transport) ---------------
+
+    def embed_prompt(self, prompt_ids) -> jnp.ndarray:
+        """Token ids → hidden states at the host boundary. What crosses into
+        the pipeline afterwards is embeddings only (≙ the reference's privacy
+        mechanism: raw text/ids never leave the accepting node,
+        ``node_worker.py:215-223``)."""
+        ids = jnp.asarray(prompt_ids, jnp.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        pos = jnp.broadcast_to(
+            jnp.arange(ids.shape[1], dtype=jnp.int32), ids.shape
+        )
+        return model_fns(self.cfg).embed(self.head_params, ids, pos)
+
+    def _require_tokenizer(self):
+        if self.tokenizer is None:
+            raise ValueError(
+                "engine has no tokenizer: construct via from_shards on a store "
+                "with tokenizer files, or pass tokenizer= explicitly"
+            )
+        return self.tokenizer
+
+
+class MonolithicEngine:
+    """Single-device engine (≙ ``inference.py``, the reference's monolithic
+    baseline) sharing the engine API for A/B correctness checks."""
+
+    def __init__(self, cfg: ModelConfig, params: Any, tokenizer=None, cache_dtype=jnp.bfloat16):
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.cache_dtype = cache_dtype
+
+    def generate_ids(self, prompt_ids, max_new_tokens: int = 128, **kw):
+        return generate(
+            self.cfg, self.params, prompt_ids, max_new_tokens,
+            cache_dtype=self.cache_dtype, **kw,
+        )

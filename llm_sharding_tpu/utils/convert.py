@@ -111,16 +111,16 @@ def params_from_hf(
         layers = _stack(
             [llama_layer_arrays(cfg, get, i, dtype) for i in range(cfg.num_hidden_layers)]
         )
-        if cfg.tie_word_embeddings:
-            lm_head = embed.T
-        else:
-            lm_head = jnp.asarray(get("lm_head.weight").T, dtype)
-        return {
+        params = {
             "embed": embed,
             "layers": layers,
             "final_norm": jnp.asarray(get("model.norm.weight"), dtype),
-            "lm_head": lm_head,
         }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = jnp.asarray(get("lm_head.weight").T, dtype)
+        # tied: no duplicate vocab×hidden buffer — final_logits contracts
+        # against the embedding table (see models/llama.py:final_logits)
+        return params
     elif cfg.model_type == "gpt2":
         pre = "transformer." if _has(get, "transformer.wte.weight") else ""
         wte = jnp.asarray(get(pre + "wte.weight"), dtype)
@@ -128,11 +128,10 @@ def params_from_hf(
             [gpt2_layer_arrays(cfg, get, i, dtype) for i in range(cfg.num_hidden_layers)]
         )
         return {
-            "embed": wte,
+            "embed": wte,  # lm_head is tied to wte — no separate buffer
             "pos_embed": jnp.asarray(get(pre + "wpe.weight"), dtype),
             "layers": layers,
             "final_norm": jnp.asarray(get(pre + "ln_f.weight"), dtype),
             "final_norm_bias": jnp.asarray(get(pre + "ln_f.bias"), dtype),
-            "lm_head": wte.T,  # GPT-2 ties lm_head to wte
         }
     raise ValueError(f"unsupported model_type: {cfg.model_type!r}")

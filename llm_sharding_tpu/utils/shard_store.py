@@ -13,7 +13,8 @@ Layout mirrors the reference's split logically — one file per unit —
       embedding.npz                  # ≙ embedding.pth   (embed [+pos_embed])
       block_{i}.npz                  # ≙ block_{i}.pth   (one decoder layer)
       final_norm.npz                 # ≙ final_norm.pth / ln_f.pth
-      lm_head.npz                    # ≙ lm_head.pth
+      lm_head.npz                    # ≙ lm_head.pth (absent when tied: the
+                                     #   last stage reuses embedding.npz)
 
 — but stores numpy ``.npz`` instead of torch pickles, and the loader stacks a
 stage's ``block_{start..end-1}`` into scan-ready ``[L, ...]`` arrays.
@@ -89,7 +90,8 @@ def save_shards(
     if "final_norm_bias" in src:
         fn["final_norm_bias"] = src["final_norm_bias"]
     _save_npz(os.path.join(out_dir, "final_norm.npz"), fn)
-    _save_npz(os.path.join(out_dir, "lm_head.npz"), {"lm_head": src["lm_head"]})
+    if "lm_head" in src:  # tied models reuse embedding.npz (no duplicate)
+        _save_npz(os.path.join(out_dir, "lm_head.npz"), {"lm_head": src["lm_head"]})
 
 
 def save_shards_streaming(
@@ -118,12 +120,11 @@ def save_shards_streaming(
             os.path.join(out_dir, "final_norm.npz"),
             {"final_norm": jnp.asarray(get("model.norm.weight"), dtype)},
         )
-        lm_head = (
-            embed.T
-            if cfg.tie_word_embeddings
-            else jnp.asarray(get("lm_head.weight").T, dtype)
-        )
-        _save_npz(os.path.join(out_dir, "lm_head.npz"), {"lm_head": lm_head})
+        if not cfg.tie_word_embeddings:
+            _save_npz(
+                os.path.join(out_dir, "lm_head.npz"),
+                {"lm_head": jnp.asarray(get("lm_head.weight").T, dtype)},
+            )
     else:  # gpt2
         from .convert import _has
 
@@ -140,7 +141,7 @@ def save_shards_streaming(
                 "final_norm_bias": jnp.asarray(get(pre + "ln_f.bias"), dtype),
             },
         )
-        _save_npz(os.path.join(out_dir, "lm_head.npz"), {"lm_head": wte.T})
+        # lm_head tied to wte — nothing extra to save
 
 
 def copy_tokenizer_files(src_dir: str, out_dir: str) -> None:
@@ -210,7 +211,14 @@ def load_stage(
         stage.update(_load_npz(os.path.join(shards_dir, "embedding.npz"), dtype))
     if end == L:
         stage.update(_load_npz(os.path.join(shards_dir, "final_norm.npz"), dtype))
-        stage.update(_load_npz(os.path.join(shards_dir, "lm_head.npz"), dtype))
+        head_path = os.path.join(shards_dir, "lm_head.npz")
+        if os.path.exists(head_path):
+            stage.update(_load_npz(head_path, dtype))
+        elif "embed" not in stage:
+            # tied model: the last stage projects against the embedding table
+            stage["embed"] = _load_npz(
+                os.path.join(shards_dir, "embedding.npz"), dtype
+            )["embed"]
     return stage
 
 

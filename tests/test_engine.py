@@ -1,0 +1,116 @@
+"""Engine tests: hot reconfiguration, compile-cache reuse, request edge
+(≙ ConfigSender/NodeController behaviors, ``/root/reference/utils/
+config_sender.py``, ``utils/node_worker.py:385-559``)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from llm_sharding_tpu.models import llama
+from llm_sharding_tpu.models.config import tiny_llama
+from llm_sharding_tpu.parallel.placement import PlacementSpec
+from llm_sharding_tpu.runtime.engine import MonolithicEngine, PipelineEngine
+
+CFG = tiny_llama(num_hidden_layers=8)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.key(5), dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def engine(params):
+    return PipelineEngine(CFG, params, num_stages=4, cache_dtype=jnp.float32)
+
+
+def test_engine_matches_monolith(engine, params):
+    prompt = np.array([[5, 9, 2, 14]], dtype=np.int32)
+    mono = MonolithicEngine(CFG, params, cache_dtype=jnp.float32)
+    a = engine.generate_ids(prompt, 8)
+    b = mono.generate_ids(prompt, 8)
+    np.testing.assert_array_equal(a.tokens, b.tokens)
+
+
+def test_hot_repartition_same_shape_no_recompile(engine, params):
+    """Repartition keeping (num_stages, pad) static shapes must reuse the
+    compiled program (SURVEY.md §7 'hot reconfiguration vs compilation')."""
+    from llm_sharding_tpu.parallel.pipeline import _pipeline_generate_jit
+
+    prompt = np.array([[3, 1, 4]], dtype=np.int32)
+    engine.apply_placement(PlacementSpec.balanced(8, 4))  # 2/2/2/2
+    r1 = engine.generate_ids(prompt, 6)
+    misses_before = _pipeline_generate_jit._cache_size()
+
+    # A new spec with the same (num_stages, max_layers_per_stage) static
+    # shapes: only device arrays change, not the compiled program.
+    engine.apply_placement(PlacementSpec.from_ranges(
+        [(0, 2), (2, 4), (4, 6), (6, 8)], 8
+    ))
+    r2 = engine.generate_ids(prompt, 6)
+    misses_after = _pipeline_generate_jit._cache_size()
+
+    assert misses_after == misses_before, "same-shape repartition recompiled"
+    np.testing.assert_array_equal(r1.tokens, r2.tokens)
+
+
+def test_hot_repartition_ragged_changes_output_not_result(engine, params):
+    """A genuinely different split (ragged) still produces identical tokens —
+    placement is an execution detail, not a semantic one."""
+    prompt = np.array([[7, 7, 3, 1]], dtype=np.int32)
+    engine.apply_placement(PlacementSpec.balanced(8, 4))
+    r_even = engine.generate_ids(prompt, 6)
+    engine.apply_placement(
+        PlacementSpec.from_ranges([(0, 5), (5, 6), (6, 7), (7, 8)], 8)
+    )
+    r_ragged = engine.generate_ids(prompt, 6)
+    np.testing.assert_array_equal(r_even.tokens, r_ragged.tokens)
+    # restore
+    engine.apply_placement(PlacementSpec.balanced(8, 4))
+
+
+def test_stage_count_change_rebuilds_mesh(engine):
+    engine.apply_placement(PlacementSpec.balanced(8, 2))
+    assert engine.mesh.shape["pipe"] == 2
+    prompt = np.array([[2, 4, 6]], dtype=np.int32)
+    res = engine.generate_ids(prompt, 4)
+    assert res.tokens.shape == (1, 7)
+    engine.apply_placement(PlacementSpec.balanced(8, 4))
+    assert engine.mesh.shape["pipe"] == 4
+
+
+def test_placement_layer_mismatch_rejected(engine):
+    with pytest.raises(ValueError, match="covers"):
+        engine.apply_placement(PlacementSpec.balanced(16, 4))
+
+
+def test_embed_prompt_request_edge(engine):
+    h = engine.embed_prompt(np.array([1, 2, 3], np.int32))
+    assert h.shape == (1, 3, CFG.hidden_size)
+
+
+def test_from_shards_roundtrip(tmp_path, params):
+    from llm_sharding_tpu.utils import shard_store
+
+    out = str(tmp_path / "store")
+    shard_store.save_shards(CFG, params, out)
+    eng = PipelineEngine.from_shards(
+        out, num_stages=2, dtype=jnp.float32, cache_dtype=jnp.float32
+    )
+    prompt = np.array([[5, 9, 2, 14]], dtype=np.int32)
+    mono = MonolithicEngine(CFG, params, cache_dtype=jnp.float32)
+    np.testing.assert_array_equal(
+        eng.generate_ids(prompt, 6).tokens, mono.generate_ids(prompt, 6).tokens
+    )
+
+
+def test_generate_many_interleaved(engine, params):
+    """Engine throughput mode: concurrent requests match solo decodes."""
+    engine.apply_placement(PlacementSpec.balanced(8, 4))
+    prompts = np.array([[5, 9, 2], [14, 3, 8]], dtype=np.int32)
+    res = engine.generate_many(prompts, 5)
+    mono = MonolithicEngine(CFG, params, cache_dtype=jnp.float32)
+    for r in range(2):
+        oracle = mono.generate_ids(prompts[r : r + 1], 5)
+        np.testing.assert_array_equal(res.tokens[r], oracle.tokens[0])

@@ -1,0 +1,125 @@
+"""Profiler tests: fit machinery, reports, memory accounting, cold-start
+(≙ the reference's NodeProfiler products, SURVEY.md §5 tracing/profiling)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from llm_sharding_tpu.models import llama
+from llm_sharding_tpu.models.config import tiny_llama
+from llm_sharding_tpu.profiler.profiler import (
+    ColdStartReport,
+    Profiler,
+    fit_latency_models,
+    kv_cache_bytes_per_layer,
+    layer_param_bytes,
+    max_layers_fit,
+    profile_cold_start,
+)
+
+CFG = tiny_llama()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+
+
+def test_fit_recovers_known_models():
+    x = np.array([8, 16, 32, 64, 128, 256, 512], np.float64)
+    y_lin = 0.003 * x + 0.5
+    fits = fit_latency_models(x, y_lin)
+    a, b = fits["linear"].coeffs
+    assert abs(a - 0.003) < 1e-9 and abs(b - 0.5) < 1e-6
+    assert fits["linear"].r2 > 0.999999
+
+    y_quad = 2e-5 * x**2 + 0.001 * x + 0.2
+    fq = fit_latency_models(x, y_quad)["quadratic"]
+    aq, bq, cq = fq.coeffs
+    assert abs(aq - 2e-5) < 1e-9 and abs(bq - 0.001) < 1e-6
+    assert fq.rmse < 1e-9
+
+
+def test_prefill_report(params):
+    prof = Profiler(CFG, params, dtype=jnp.float32)
+    rep = prof.profile_prefill(lengths=(8, 16, 32), repeats=2)
+    assert rep.lengths == (8, 16, 32)
+    assert all(t > 0 for t in rep.latencies_s)
+    assert rep.capability_c_k > 0
+    assert set(rep.fits) == {"linear", "quadratic"}
+    assert rep.num_layers_measured == CFG.num_hidden_layers
+
+
+def test_prefill_respects_max_position(params):
+    prof = Profiler(CFG, params, dtype=jnp.float32)
+    rep = prof.profile_prefill(lengths=(8, 16, 4096), repeats=1)
+    assert 4096 not in rep.lengths  # ≙ node_profiler.py:352 guard
+
+
+def test_partial_load_normalization(params):
+    """Capability from a 2-layer slice is normalized to full-model units
+    (≙ layer_num/loaded scaling, node_profiler.py:377)."""
+    sub = {
+        "layers": jax.tree.map(lambda a: a[:2], params["layers"]),
+    }
+    prof = Profiler(CFG, {**params, "layers": sub["layers"]}, dtype=jnp.float32)
+    assert prof.num_layers_held == 2
+    rep = prof.profile_prefill(lengths=(8, 16), repeats=1)
+    assert rep.num_layers_measured == 2
+    assert rep.capability_c_k > 0
+
+
+def test_decode_report_and_similarity(params):
+    prof = Profiler(CFG, params, dtype=jnp.float32)
+    pre = prof.profile_prefill(lengths=(8, 16, 32), repeats=1)
+    dec = prof.profile_decode(max_tokens=16, prompt_len=8, measure_every=4)
+    assert len(dec.token_counts) == len(dec.cumulative_s)
+    assert dec.cumulative_s[-1] >= dec.cumulative_s[0]
+    verdict = Profiler.similarity_verdict(pre, dec)
+    assert verdict.threshold == 0.30
+    assert np.isfinite(verdict.avg_ratio)
+
+
+def test_decode_requires_full_model(params):
+    sub_layers = jax.tree.map(lambda a: a[:2], params["layers"])
+    prof = Profiler(CFG, {**params, "layers": sub_layers}, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="full model"):
+        prof.profile_decode(max_tokens=4)
+
+
+def test_stage_profile_runs_for_partial_slice(params):
+    """Assisted-profiling equivalent: any layer range times standalone."""
+    sub_layers = jax.tree.map(lambda a: a[2:4], params["layers"])
+    prof = Profiler(CFG, {**params, "layers": sub_layers}, dtype=jnp.float32)
+    t = prof.profile_stage(seq_len=16, repeats=2)
+    assert t > 0
+
+
+def test_layer_bytes_exact(params):
+    per_layer = jax.tree.map(lambda a: a[0], params["layers"])
+    actual = sum(a.size * 4 for a in jax.tree.leaves(per_layer))  # fp32
+    assert layer_param_bytes(CFG, jnp.float32) == actual
+
+
+def test_max_layers_fit_accounting():
+    # budget for exactly 3 layers + head/embed + 10% reserve
+    head = CFG.vocab_size * CFG.hidden_size * 2 * 2 + CFG.hidden_size * 2
+    per = layer_param_bytes(CFG) + kv_cache_bytes_per_layer(CFG, 1, 64)
+    hbm = int((head + 3 * per) / 0.9) + 1024
+    got = max_layers_fit(CFG, kv_capacity=64, hbm_bytes=hbm)
+    assert got == 3
+    # never reports more layers than the model has
+    assert max_layers_fit(CFG, kv_capacity=64, hbm_bytes=10**12) == CFG.num_hidden_layers
+
+
+def test_cold_start(tmp_path, params):
+    from llm_sharding_tpu.utils import shard_store
+
+    out = str(tmp_path / "cs")
+    shard_store.save_shards(CFG, params, out)
+    rep = profile_cold_start(out, dtype=jnp.float32)
+    assert isinstance(rep, ColdStartReport)
+    assert rep.num_layers == CFG.num_hidden_layers
+    assert len(rep.per_layer_s) == CFG.num_hidden_layers
+    assert rep.total_s >= max(rep.per_layer_s)
