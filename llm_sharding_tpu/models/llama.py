@@ -95,6 +95,35 @@ def embed(params: Params, token_ids: jnp.ndarray) -> jnp.ndarray:
     return params["embed"][token_ids]
 
 
+def attn_mlp_block(
+    cfg: ModelConfig,
+    p: Params,
+    h: jnp.ndarray,  # [B, S, H]
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    attn_fn,  # (q[B,S,Nh,D], k[B,S,Nkv,D], v[B,S,Nkv,D]) -> [B,S,Nh,D]
+) -> jnp.ndarray:
+    """One llama block with the attention mechanism injected — the single
+    implementation behind the cached (pipeline/decode) path and the
+    ring-attention (context-parallel) path."""
+    B, S, H = h.shape
+    D = cfg.head_dim_
+    Nh, Nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+
+    x = rms_norm(h, p["input_norm"], cfg.rms_norm_eps)
+    q = apply_rope((x @ p["wq"]).reshape(B, S, Nh, D), cos, sin)
+    k = apply_rope((x @ p["wk"]).reshape(B, S, Nkv, D), cos, sin)
+    v = (x @ p["wv"]).reshape(B, S, Nkv, D)
+
+    attn = attn_fn(q, k, v)
+    h = h + attn.reshape(B, S, Nh * D) @ p["wo"]
+
+    x = rms_norm(h, p["post_norm"], cfg.rms_norm_eps)
+    mlp = (jax.nn.silu((x @ p["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+           * (x @ p["w_up"])) @ p["w_down"]
+    return h + mlp
+
+
 def decoder_layer(
     cfg: ModelConfig,
     p: Params,  # un-stacked single-layer params
@@ -107,27 +136,20 @@ def decoder_layer(
     kv_positions: jnp.ndarray,  # [B, C] per-slot key positions (post-write)
     length: jnp.ndarray,  # scalar int32: shared write offset for this step
 ):
-    B, S, H = h.shape
-    D = cfg.head_dim_
-    Nh, Nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+    rows = {}
 
-    x = rms_norm(h, p["input_norm"], cfg.rms_norm_eps)
-    q = (x @ p["wq"]).reshape(B, S, Nh, D)
-    k = (x @ p["wk"]).reshape(B, S, Nkv, D)
-    v = (x @ p["wv"]).reshape(B, S, Nkv, D)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
+    def attn_fn(q, k, v):
+        k_r = jax.lax.dynamic_update_slice(
+            k_row, k.astype(k_row.dtype), (0, length, 0, 0)
+        )
+        v_r = jax.lax.dynamic_update_slice(
+            v_row, v.astype(v_row.dtype), (0, length, 0, 0)
+        )
+        rows["k"], rows["v"] = k_r, v_r
+        return cached_attention(q, k_r, v_r, positions, kv_positions)
 
-    k_row = jax.lax.dynamic_update_slice(k_row, k.astype(k_row.dtype), (0, length, 0, 0))
-    v_row = jax.lax.dynamic_update_slice(v_row, v.astype(v_row.dtype), (0, length, 0, 0))
-
-    attn = cached_attention(q, k_row, v_row, positions, kv_positions)
-    h = h + attn.reshape(B, S, Nh * D) @ p["wo"]
-
-    x = rms_norm(h, p["post_norm"], cfg.rms_norm_eps)
-    mlp = (jax.nn.silu((x @ p["w_gate"]).astype(jnp.float32)).astype(x.dtype)
-           * (x @ p["w_up"])) @ p["w_down"]
-    return h + mlp, k_row, v_row
+    h = attn_mlp_block(cfg, p, h, cos, sin, attn_fn)
+    return h, rows["k"], rows["v"]
 
 
 def forward_layers(

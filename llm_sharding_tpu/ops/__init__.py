@@ -1,1 +1,1 @@
-from . import attention, norms, rope, sampling  # noqa: F401
+from . import attention, norms, ring_attention, rope, sampling  # noqa: F401

@@ -1,1 +1,1 @@
-from . import mesh, pipeline, placement, schedule  # noqa: F401
+from . import context, distributed, mesh, pipeline, placement, schedule, tensor  # noqa: F401

@@ -1,0 +1,140 @@
+"""Context (sequence) parallelism: long-context prefill over a "seq" mesh axis.
+
+A capability dimension absent from the reference (SURVEY.md §5: "no ring
+attention, no context parallel … whole sequence on every stage"). Weights are
+replicated across the axis; the token dimension is sharded; attention runs as
+ring attention (``ops/ring_attention.py``) so each device only ever holds
+S/N-sized score blocks while computing exact global causal attention.
+
+Composable with the pipeline: use context parallelism for the long prefill,
+then decode with per-stage KV caches (decode is a single-token workload with
+no sequence dimension to shard).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.cache import POS_SENTINEL
+from ..models.config import ModelConfig
+from ..ops.norms import rms_norm
+from ..ops.ring_attention import ring_attention
+from ..ops.rope import rope_cos_sin
+from .mesh import SEQ_AXIS
+
+
+def _ctx_layer(cfg: ModelConfig, p: Any, h, cos, sin, q_pos, kv_pos):
+    """One llama decoder layer with ring attention over the seq axis — shares
+    ``models/llama.py:attn_mlp_block``; only the attention mechanism differs."""
+    from ..models.llama import attn_mlp_block
+
+    return attn_mlp_block(
+        cfg, p, h, cos, sin,
+        lambda q, k, v: ring_attention(q, k, v, q_pos, kv_pos, SEQ_AXIS),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "mesh", "full_logits"))
+def _context_prefill_jit(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    params: Any,
+    token_ids: jnp.ndarray,  # [B, S], S divisible by mesh["seq"]
+    positions: jnp.ndarray,  # [B, S] absolute (sentinel on pads)
+    last_position: jnp.ndarray,  # [B] absolute position of the last real token
+    full_logits: bool,
+):
+    if cfg.model_type != "llama":
+        raise NotImplementedError("context parallelism: llama family first")
+
+    def body(params, ids_chunk, pos_chunk, last_position):
+        h = params["embed"][ids_chunk]
+        cos, sin = rope_cos_sin(pos_chunk, cfg, dtype=jnp.float32)
+
+        def scan_body(h, p):
+            return _ctx_layer(cfg, p, h, cos, sin, pos_chunk, pos_chunk), None
+
+        h, _ = jax.lax.scan(scan_body, h, params["layers"])
+        h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+
+        def project(x):
+            if "lm_head" in params:
+                return (x @ params["lm_head"]).astype(jnp.float32)
+            return jnp.einsum("...h,vh->...v", x, params["embed"]).astype(
+                jnp.float32
+            )
+
+        if full_logits:
+            return project(h)
+        # Long-context regime: only the last real token's logits are needed
+        # to start decode. Each device selects its local candidate (zero if
+        # the last position lives elsewhere) and a psum assembles it —
+        # O(B·H) traffic instead of O(B·S·V) host gather.
+        sel = (pos_chunk == last_position[:, None]).astype(h.dtype)  # [B, s]
+        local_last = jnp.einsum("bs,bsh->bh", sel, h)
+        last_h = jax.lax.psum(local_last, SEQ_AXIS)
+        return project(last_h)  # [B, V]
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(None, SEQ_AXIS), P(None, SEQ_AXIS), P()),
+        out_specs=P(None, SEQ_AXIS) if full_logits else P(),
+        check_vma=False,
+    )(params, token_ids, positions, last_position)
+
+
+def context_prefill(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    params: Any,
+    token_ids,
+    prompt_len=None,
+    *,
+    full_logits: bool = False,
+) -> np.ndarray:
+    """Sequence-parallel prefill.
+
+    Default: last real token's logits ``[B, V]`` — what decode needs, with
+    O(B·H) cross-device traffic. ``full_logits=True`` returns ``[B, S, V]``
+    (testing/scoring only — materializes the whole logit tensor).
+
+    ``S`` must be divisible by the mesh's "seq" axis size (pad the prompt and
+    pass ``prompt_len``; padded positions are masked by the sentinel exactly
+    like the single-host path)."""
+    token_ids = jnp.asarray(token_ids, jnp.int32)
+    if token_ids.ndim == 1:
+        token_ids = token_ids[None]
+    B, S = token_ids.shape
+    n = mesh.shape[SEQ_AXIS]
+    if S % n != 0:
+        raise ValueError(
+            f"sequence length {S} not divisible by seq-axis size {n}; pad the "
+            "prompt and pass prompt_len"
+        )
+    if prompt_len is None:
+        prompt_len = jnp.full((B,), S, jnp.int32)
+    else:
+        prompt_len = jnp.asarray(prompt_len, jnp.int32)
+    idx = jnp.arange(S, dtype=jnp.int32)
+    positions = jnp.where(
+        idx[None, :] < prompt_len[:, None], idx[None, :], POS_SENTINEL
+    )
+    return np.asarray(
+        _context_prefill_jit(
+            cfg, mesh, params, token_ids, positions, prompt_len - 1, full_logits
+        )
+    )
+
+
+def context_mesh(num_devices: int, devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < num_devices:
+        raise ValueError(f"need {num_devices} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[:num_devices]), (SEQ_AXIS,))

@@ -1,0 +1,76 @@
+"""Multi-host / multi-slice distributed setup.
+
+The reference scales across machines with per-host OS processes wired by
+IP:port ZMQ configs (``/root/reference/send_config.py``, ``run_this.sh``).
+The TPU-native equivalent is JAX's multi-controller runtime: every host runs
+the SAME program, ``jax.distributed.initialize`` forms the cluster, and the
+global device list becomes one mesh — collectives ride ICI within a slice and
+DCN across slices. The "config push" disappears: placement is part of the
+compiled program (see parallel/placement.py).
+
+Axis layout convention for hybrid meshes (outer → inner):
+``(data, pipe, seq, tensor)`` — tensor innermost so its all-reduces stay on
+the fastest ICI links; data outermost so replicas only sync at host
+boundaries (they don't communicate at all during inference).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from .mesh import DATA_AXIS, PIPE_AXIS, SEQ_AXIS
+from .tensor import TENSOR_AXIS
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the multi-host cluster (one call per host process, before any
+    backend use). On Cloud TPU all three args auto-detect from metadata; pass
+    them explicitly elsewhere (≙ the reference's manual IP wiring,
+    ``send_config.py:5-14`` — here it's one bootstrap address, not a full
+    topology map)."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def hybrid_mesh(
+    *,
+    data: int = 1,
+    pipe: int = 1,
+    seq: int = 1,
+    tensor: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """N-D mesh over (data, pipe, seq, tensor), axis sizes multiplying to the
+    device count used. Uses all global devices by default — correct for
+    multi-host SPMD where every process sees the full device list."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = data * pipe * seq * tensor
+    if len(devices) < need:
+        raise ValueError(
+            f"mesh {data}x{pipe}x{seq}x{tensor} needs {need} devices, "
+            f"have {len(devices)}"
+        )
+    arr = np.asarray(devices[:need]).reshape(data, pipe, seq, tensor)
+    return Mesh(arr, (DATA_AXIS, PIPE_AXIS, SEQ_AXIS, TENSOR_AXIS))
+
+
+def process_local_batch(global_batch: int) -> int:
+    """Rows of a data-parallel batch this host should feed (multi-controller
+    convention: each host materializes only its slice)."""
+    n = jax.process_count()
+    if global_batch % n != 0:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by process count {n}"
+        )
+    return global_batch // n

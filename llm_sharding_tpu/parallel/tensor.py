@@ -1,0 +1,106 @@
+"""Tensor parallelism via GSPMD: megatron-style sharding with zero model edits.
+
+The reference has no TP ("every layer's weights live wholly on one node",
+SURVEY.md §2) — on TPU it falls out of the sharding system: annotate each
+weight with a ``NamedSharding`` over the "tensor" mesh axis and jit the
+UNCHANGED model; XLA partitions every matmul and inserts the all-reduces
+(psum after wo/w_down) that Megatron implements by hand.
+
+Layout (llama):
+- attention: wq/wk/wv column-parallel (head dim), wo row-parallel
+- MLP: w_gate/w_up column-parallel (intermediate dim), w_down row-parallel
+- lm_head column-parallel (vocab-sharded logits)
+- norms/embedding replicated
+
+Requires num_attention_heads, num_key_value_heads and intermediate_size
+divisible by the axis size.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.config import ModelConfig
+
+TENSOR_AXIS = "tensor"
+
+
+def tensor_mesh(num_devices: int, devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < num_devices:
+        raise ValueError(f"need {num_devices} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[:num_devices]), (TENSOR_AXIS,))
+
+
+def llama_tp_specs(stacked: bool = True) -> dict[str, P]:
+    """PartitionSpecs for (layer-stacked) llama params over TENSOR_AXIS."""
+    L = (None,) if stacked else ()
+    col = P(*L, None, TENSOR_AXIS)  # [L, in, out] sharded on out
+    row = P(*L, TENSOR_AXIS, None)  # [L, in, out] sharded on in
+    rep = P()
+    return {
+        "layers": {
+            "input_norm": rep,
+            "wq": col,
+            "wk": col,
+            "wv": col,
+            "wo": row,
+            "post_norm": rep,
+            "w_gate": col,
+            "w_up": col,
+            "w_down": row,
+        },
+        "embed": rep,
+        "final_norm": rep,
+        "lm_head": P(None, TENSOR_AXIS),
+    }
+
+
+def validate_tp(cfg: ModelConfig, tp: int) -> None:
+    for name, val in (
+        ("num_attention_heads", cfg.num_attention_heads),
+        ("num_key_value_heads", cfg.num_key_value_heads),
+        ("intermediate_size", cfg.intermediate_size),
+    ):
+        if val % tp != 0:
+            raise ValueError(f"{name}={val} not divisible by tensor size {tp}")
+
+
+def shard_params_tp(cfg: ModelConfig, params: Any, mesh: Mesh) -> Any:
+    """device_put params with megatron shardings; GSPMD does the rest."""
+    if cfg.model_type != "llama":
+        raise NotImplementedError("TP specs: llama family first")
+    tp = mesh.shape[TENSOR_AXIS]
+    validate_tp(cfg, tp)
+    specs = llama_tp_specs()
+
+    def put(path_spec, leaf):
+        return jax.device_put(leaf, NamedSharding(mesh, path_spec))
+
+    out = {
+        "embed": put(specs["embed"], params["embed"]),
+        "final_norm": put(specs["final_norm"], params["final_norm"]),
+        "layers": {
+            k: put(specs["layers"][k], v) for k, v in params["layers"].items()
+        },
+    }
+    if "lm_head" in params:
+        out["lm_head"] = put(specs["lm_head"], params["lm_head"])
+    return out
+
+
+def shard_cache_tp(cache, mesh: Mesh):
+    """KV cache sharded over heads ([L, B, C, Hkv, D] → Hkv on the axis)."""
+    kv_spec = NamedSharding(mesh, P(None, None, None, TENSOR_AXIS, None))
+    rep = NamedSharding(mesh, P())
+    return cache._replace(
+        k=jax.device_put(cache.k, kv_spec),
+        v=jax.device_put(cache.v, kv_spec),
+        pos=jax.device_put(cache.pos, rep),
+        length=jax.device_put(cache.length, rep),
+    )

@@ -118,3 +118,54 @@ def test_layer_mask_passthrough(params):
     sub_cache = init_cache(CFG, B, capacity=S, num_layers=2, dtype=jnp.float32)
     h_sub, _ = llama.forward_layers(CFG, sub_layers, h, sub_cache, positions)
     np.testing.assert_allclose(np.asarray(h_out), np.asarray(h_sub), atol=1e-5)
+
+
+def test_llama3_rope_scaling_matches_hf():
+    """Llama-3.x piecewise RoPE frequency scaling parity with HF
+    (BASELINE config #4 needs this; ops/rope.py:_llama3_scale_inv_freq)."""
+    from llm_sharding_tpu.models.config import RopeScaling
+
+    cfg3 = tiny_llama(
+        rope_theta=500000.0,
+        max_position_embeddings=128,
+        rope_scaling=RopeScaling(
+            factor=8.0,
+            low_freq_factor=1.0,
+            high_freq_factor=4.0,
+            original_max_position_embeddings=64,
+        ),
+    )
+    hf_cfg = LlamaConfig(
+        vocab_size=cfg3.vocab_size,
+        hidden_size=cfg3.hidden_size,
+        intermediate_size=cfg3.intermediate_size,
+        num_hidden_layers=cfg3.num_hidden_layers,
+        num_attention_heads=cfg3.num_attention_heads,
+        num_key_value_heads=cfg3.num_key_value_heads,
+        max_position_embeddings=cfg3.max_position_embeddings,
+        rms_norm_eps=cfg3.rms_norm_eps,
+        rope_theta=cfg3.rope_theta,
+        rope_scaling={
+            "rope_type": "llama3",
+            "factor": 8.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 64,
+        },
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(42)
+    model = LlamaForCausalLM(hf_cfg)
+    model.eval()
+    sd = {k: v.detach().numpy() for k, v in model.state_dict().items()}
+    params3 = params_from_hf(cfg3, sd, dtype=jnp.float32)
+
+    B, S = 1, 96  # long enough to exercise the scaled low-frequency band
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, cfg3.vocab_size, (B, S)).astype(np.int32)
+    ref = hf_logits(model, ids)
+
+    cache = init_cache(cfg3, B, capacity=S, dtype=jnp.float32)
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    logits, _ = llama.forward(cfg3, params3, jnp.asarray(ids), cache, positions)
+    np.testing.assert_allclose(np.asarray(logits), ref, atol=3e-4, rtol=2e-3)
