@@ -1,1 +1,1 @@
-from . import attention, norms, ring_attention, rope, sampling  # noqa: F401
+from . import attention, flash_attention, norms, ring_attention, rope, sampling  # noqa: F401

@@ -1,0 +1,181 @@
+"""Pallas TPU kernel: fused causal flash attention for the prefill hot path.
+
+The XLA path (``ops/attention.py``) materializes a [B, Nkv, G, S, C] score
+tensor; this kernel streams K/V through VMEM in blocks with online-softmax
+accumulation (scores never leave on-chip memory), blocked for the MXU with
+fp32 accumulation. Same position-based masking contract as
+``cached_attention`` (``kv_pos <= q_pos``; sentinel = masked) so it is a
+drop-in for prefill over the KV cache.
+
+Grid: (B, Nh, S/BLOCK_Q, C/BLOCK_K) — the KV dimension is innermost and
+sequential; scratch accumulators (acc, m, l) carry the online softmax across
+KV blocks (standard flash attention recurrence). Masking uses -1e30 (not
+-inf): a block that is entirely future/padding contributes p=1 rows under a
+still--1e30 running max, and the first real block's correction factor
+exp(-1e30 - m_real) = 0 wipes that garbage — so fully-masked prefixes need no
+special casing, and never-valid (sentinel) query rows degrade to the same
+uniform-average garbage the XLA path produces for them (discarded by callers).
+
+Kernel selection: ``attention_prefill`` picks pallas on TPU for prefill-sized
+inputs and the XLA implementation elsewhere (CPU meshes, decode S=1, head_dim
+not MXU-aligned). Identical numerics either way (interpret-mode tested).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import cached_attention
+
+BLOCK_Q = 256
+BLOCK_K = 512
+NEG_INF = -1e30  # python float: jnp constants can't be captured by kernels
+
+
+def _flash_kernel(
+    q_ref,  # [1, 1, BQ, D]
+    k_ref,  # [1, 1, BK, D]
+    v_ref,  # [1, 1, BK, D]
+    qpos_ref,  # [1, BQ, 1]
+    kvpos_ref,  # [1, BK, 1]
+    out_ref,  # [1, 1, BQ, D]
+    acc_ref,  # scratch [BQ, D] f32
+    m_ref,  # scratch [BQ, 128] f32 (running max, lane-replicated)
+    l_ref,  # scratch [BQ, 128] f32 (running denominator)
+    *,
+    scale,
+    kv_blocks,
+):
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0, 0]  # [BQ, D] bf16/f32
+    k = k_ref[0, 0]  # [BK, D]
+    v = v_ref[0, 0]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [BQ, BK] f32
+
+    mask = kvpos_ref[0, :, 0][None, :] <= qpos_ref[0, :, 0][:, None]
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_prev = m_ref[:, :1]  # [BQ, 1]
+    l_prev = l_ref[:, :1]
+    m_blk = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_blk)
+    p = jnp.exp(s - m_new)  # [BQ, BK]
+    corr = jnp.exp(m_prev - m_new)  # [BQ, 1]
+    l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [BQ, D]
+    acc_ref[:] = acc_ref[:] * corr + pv
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == kv_blocks - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        out_ref[0, 0] = (acc_ref[:] / jnp.maximum(l, 1e-30)).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def flash_attention(
+    q: jnp.ndarray,  # [B, S, Nh, D] (RoPE'd)
+    k_cache: jnp.ndarray,  # [B, C, Nkv, D] — keys already written
+    v_cache: jnp.ndarray,  # [B, C, Nkv, D]
+    q_positions: jnp.ndarray,  # [B, S]
+    kv_positions: jnp.ndarray,  # [B, C]
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    B, S, Nh, D = q.shape
+    C, Nkv = k_cache.shape[1], k_cache.shape[2]
+    G = Nh // Nkv
+    if scale is None:
+        scale = D ** -0.5
+
+    block_q = min(BLOCK_Q, S)
+    block_k = min(BLOCK_K, C)
+    pad_q = (-S) % block_q
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+        q_positions = jnp.pad(
+            q_positions, ((0, 0), (0, pad_q)), constant_values=jnp.int32(2**30)
+        )
+    Sp = S + pad_q
+    pad_k = (-C) % block_k
+    if pad_k:
+        k_cache = jnp.pad(k_cache, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        v_cache = jnp.pad(v_cache, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        # padded kv slots carry the sentinel so they are always masked
+        kv_positions = jnp.pad(
+            kv_positions, ((0, 0), (0, pad_k)), constant_values=jnp.int32(2**30)
+        )
+    Cp = C + pad_k
+    kv_blocks = Cp // block_k
+
+    # head-major layouts for Mosaic (sublane, lane) = (seq, head_dim) tiling
+    qh = jnp.transpose(q, (0, 2, 1, 3))  # [B, Nh, Sp, D]
+    kh = jnp.transpose(k_cache, (0, 2, 1, 3))  # [B, Nkv, Cp, D]
+    vh = jnp.transpose(v_cache, (0, 2, 1, 3))
+    qp = q_positions[..., None]  # [B, Sp, 1]
+    kp = kv_positions[..., None]  # [B, Cp, 1]
+
+    grid = (B, Nh, Sp // block_q, kv_blocks)
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, scale=scale, kv_blocks=kv_blocks),
+        out_shape=jax.ShapeDtypeStruct((B, Nh, Sp, D), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // G, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // G, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, h, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, 1), lambda b, h, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qh, kh, vh, qp, kp)
+    return jnp.transpose(out, (0, 2, 1, 3))[:, :S]
+
+
+def attention_prefill(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    kv_positions: jnp.ndarray,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Kernel selection: pallas flash kernel on TPU for prefill-sized inputs,
+    XLA ``cached_attention`` otherwise (CPU meshes, decode S=1, non-aligned
+    head_dim). Identical numerics either way (tested via interpret mode)."""
+    B, S, Nh, D = q.shape
+    use_pallas = (
+        jax.default_backend() == "tpu"
+        and S > 1
+        and D % 128 == 0
+    )
+    if use_pallas:
+        return flash_attention(q, k_cache, v_cache, q_positions, kv_positions, scale)
+    return cached_attention(q, k_cache, v_cache, q_positions, kv_positions, scale)

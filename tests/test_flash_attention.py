@@ -1,0 +1,67 @@
+"""Pallas flash-attention kernel == XLA cached_attention (interpret mode on
+CPU; the same kernel runs compiled on TPU via attention_prefill selection)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from llm_sharding_tpu.models.cache import POS_SENTINEL
+from llm_sharding_tpu.ops.attention import cached_attention
+from llm_sharding_tpu.ops.flash_attention import flash_attention
+
+
+def _rand(shape, seed):
+    return jnp.asarray(np.random.default_rng(seed).normal(size=shape), jnp.float32)
+
+
+def test_flash_matches_xla_basic():
+    B, S, C, Nh, Nkv, D = 2, 16, 32, 4, 2, 128
+    q = _rand((B, S, Nh, D), 0)
+    k = _rand((B, C, Nkv, D), 1)
+    v = _rand((B, C, Nkv, D), 2)
+    # prefill at offset 8: cache holds 8 old + S new keys
+    q_pos = jnp.broadcast_to(jnp.arange(8, 8 + S), (B, S)).astype(jnp.int32)
+    kv_pos = jnp.where(
+        jnp.arange(C) < 8 + S, jnp.arange(C), POS_SENTINEL
+    )[None].astype(jnp.int32)
+    kv_pos = jnp.broadcast_to(kv_pos, (B, C))
+
+    want = cached_attention(q, k, v, q_pos, kv_pos)
+    got = flash_attention(q, k, v, q_pos, kv_pos, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_flash_ragged_block_q_padding():
+    """S not a multiple of the 128-token query block exercises the pad path."""
+    B, S, C, Nh, Nkv, D = 1, 130, 256, 2, 2, 128
+    q = _rand((B, S, Nh, D), 3)
+    k = _rand((B, C, Nkv, D), 4)
+    v = _rand((B, C, Nkv, D), 5)
+    q_pos = jnp.broadcast_to(jnp.arange(S), (B, S)).astype(jnp.int32)
+    kv_pos = jnp.where(jnp.arange(C) < S, jnp.arange(C), POS_SENTINEL)[None]
+    kv_pos = jnp.broadcast_to(kv_pos, (B, C)).astype(jnp.int32)
+
+    want = cached_attention(q, k, v, q_pos, kv_pos)
+    got = flash_attention(q, k, v, q_pos, kv_pos, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_flash_with_padded_rows():
+    """Sentinel query positions (padded batch rows) stay finite and match."""
+    B, S, C, Nh, Nkv, D = 2, 8, 16, 2, 2, 128
+    q = _rand((B, S, Nh, D), 6)
+    k = _rand((B, C, Nkv, D), 7)
+    v = _rand((B, C, Nkv, D), 8)
+    idx = jnp.arange(S, dtype=jnp.int32)
+    plen = jnp.array([8, 5])
+    q_pos = jnp.where(idx[None] < plen[:, None], idx[None], POS_SENTINEL)
+    kv_idx = jnp.arange(C, dtype=jnp.int32)
+    kv_pos = jnp.where(kv_idx[None] < plen[:, None], kv_idx[None], POS_SENTINEL)
+
+    want = cached_attention(q, k, v, q_pos, kv_pos)
+    got = flash_attention(q, k, v, q_pos, kv_pos, interpret=True)
+    assert np.isfinite(np.asarray(got)[1, :5]).all()
+    np.testing.assert_allclose(
+        np.asarray(got)[1, :5], np.asarray(want)[1, :5], atol=2e-5
+    )
+    np.testing.assert_allclose(np.asarray(got)[0], np.asarray(want)[0], atol=2e-5)
