@@ -169,6 +169,8 @@ def llama2_13b() -> ModelConfig:
 
 
 def llama3_8b() -> ModelConfig:
+    # Llama-3-8B proper: plain 500k-theta RoPE, 8k context, NO rope_scaling
+    # (only the 3.1+ releases scale frequencies — see llama31_8b).
     return ModelConfig(
         vocab_size=128256,
         hidden_size=4096,
@@ -178,9 +180,16 @@ def llama3_8b() -> ModelConfig:
         num_key_value_heads=8,
         max_position_embeddings=8192,
         rope_theta=500000.0,
-        rope_scaling=RopeScaling(),
         bos_token_id=128000,
         eos_token_id=128001,
+    )
+
+
+def llama31_8b() -> ModelConfig:
+    return dataclasses.replace(
+        llama3_8b(),
+        max_position_embeddings=131072,
+        rope_scaling=RopeScaling(),
     )
 
 

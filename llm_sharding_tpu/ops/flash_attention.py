@@ -18,7 +18,15 @@ uniform-average garbage the XLA path produces for them (discarded by callers).
 
 Kernel selection: ``attention_prefill`` picks pallas on TPU for prefill-sized
 inputs and the XLA implementation elsewhere (CPU meshes, decode S=1, head_dim
-not MXU-aligned). Identical numerics either way (interpret-mode tested).
+not MXU-aligned). Identical numerics either way (interpret-mode tested on CPU;
+cross-checked against the XLA path on a real v5e chip up to S=C=2048 bf16).
+
+VMEM note: per-step working set is block-bounded (~2.5 MB at BLOCK_Q=256 /
+BLOCK_K=512 / D=128) and shape-independent, comfortably inside the 16 MB
+scoped-VMEM limit. Position operands MUST keep their 2-D layouts (qpos
+sublane-major, kvpos lane-major — see ``_flash_kernel``); 1-D position
+vectors force Mosaic relayouts that blow the scoped-VMEM stack (~88 MB) and
+fail compilation at any multi-block grid (the ADVICE r1 finding).
 """
 
 from __future__ import annotations
@@ -41,8 +49,8 @@ def _flash_kernel(
     q_ref,  # [1, 1, BQ, D]
     k_ref,  # [1, 1, BK, D]
     v_ref,  # [1, 1, BK, D]
-    qpos_ref,  # [1, BQ, 1]
-    kvpos_ref,  # [1, BK, 1]
+    qpos_ref,  # [1, BQ, 1] — sublane-major: rows align with score rows
+    kvpos_ref,  # [1, 1, BK] — lane-major: columns align with score columns
     out_ref,  # [1, 1, BQ, D]
     acc_ref,  # scratch [BQ, D] f32
     m_ref,  # scratch [BQ, 128] f32 (running max, lane-replicated)
@@ -67,7 +75,13 @@ def _flash_kernel(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale  # [BQ, BK] f32
 
-    mask = kvpos_ref[0, :, 0][None, :] <= qpos_ref[0, :, 0][:, None]
+    # Layout-critical: qpos arrives as a [BQ, 1] sublane vector and kvpos as a
+    # [1, BK] lane vector, so this broadcastred compare maps directly onto the
+    # [BQ, BK] score tile with NO vector relayout. Reading both as 1-D vectors
+    # (the round-1 layout) forced Mosaic into lane↔sublane relayouts whose
+    # scoped-VMEM stack blew past the 16 MB limit (~88 MB) at any
+    # multi-block grid — the compile failure flagged in ADVICE r1.
+    mask = kvpos_ref[0] <= qpos_ref[0]  # [BQ, BK]
     s = jnp.where(mask, s, NEG_INF)
 
     m_prev = m_ref[:, :1]  # [BQ, 1]
@@ -131,8 +145,8 @@ def flash_attention(
     qh = jnp.transpose(q, (0, 2, 1, 3))  # [B, Nh, Sp, D]
     kh = jnp.transpose(k_cache, (0, 2, 1, 3))  # [B, Nkv, Cp, D]
     vh = jnp.transpose(v_cache, (0, 2, 1, 3))
-    qp = q_positions[..., None]  # [B, Sp, 1]
-    kp = kv_positions[..., None]  # [B, Cp, 1]
+    qp = q_positions[..., None]  # [B, Sp, 1] — sublane-major (see kernel)
+    kp = kv_positions[:, None, :]  # [B, 1, Cp] — lane-major
 
     grid = (B, Nh, Sp // block_q, kv_blocks)
     out = pl.pallas_call(
@@ -144,7 +158,7 @@ def flash_attention(
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // G, j, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // G, j, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, h, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, 1), lambda b, h, i, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, h, i, j: (b, 0, j)),
         ],
         out_specs=pl.BlockSpec(
             (1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)
@@ -154,6 +168,9 @@ def flash_attention(
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(qh, kh, vh, qp, kp)
     return jnp.transpose(out, (0, 2, 1, 3))[:, :S]

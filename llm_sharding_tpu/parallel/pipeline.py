@@ -14,16 +14,22 @@ jit-compiled program under ``shard_map``:
   the reference's torch.save→disk→TCP→disk→torch.load wire format
   (``node_worker.py:44-67``), i.e. microseconds instead of a double disk
   round-trip per hop.
+- The vocab head is SHARDED over the pipe axis (see ``parallel/head.py``):
+  embedding lookups psum partial rows, the greedy winner is assembled from
+  per-shard logit maxima — the reference's role split (embedding on
+  user-facing nodes, lm_head on the last node, ``node_worker.py:105-125,
+  155-164``) becomes vocab parallelism, and no stage holds or computes the
+  full vocab.
 - The next-token ring closure (last stage → argmax → token id back to node 0,
   ``node_worker.py:515-525``) happens in-program: the final hidden block
-  lands on stage 0 by the same ring permute, and stage 0 computes logits and
-  re-embeds. No host round-trip per token.
+  lands on stage 0 by the same ring permute; its last-position hidden is
+  psum-broadcast and all stages agree on the next token — so stop
+  bookkeeping (EOS/max-token, ``node_worker.py:290-292``) is replicated and
+  needs no extra collective (the in-program analogue of the reference's
+  ring-propagated clear-KV command, ``:507-513``).
 - RoPE is recomputed per-stage from the position scalar instead of shipping
   (cos, sin) down the chain with every activation
   (``node_worker.py:238-243`` — see ops/rope.py).
-- EOS/max-token stop matches ``node_worker.py:290-292``; the done flag is
-  broadcast to all stages with a 1-int psum (the in-program analogue of the
-  reference's ring-propagated clear-KV command, ``:507-513``).
 
 Chain semantics match the reference exactly: one request in flight, stages
 idle while the token is elsewhere (SURVEY.md §2 "exactly one parallelism
@@ -45,30 +51,29 @@ from ..models import gpt2, llama
 from ..models.cache import KVCache, POS_SENTINEL
 from ..models.config import ModelConfig
 from ..ops.sampling import is_stop as _is_stop
+from .head import (
+    head_specs,
+    is_sharded_head,
+    local_view,
+    psum_from,
+    shard_head_host,
+    sp_embed,
+    sp_next_token,
+)
 from .mesh import PIPE_AXIS
 
 
 class ModelFns(NamedTuple):
     """Architecture dispatch for the pipeline (llama / gpt2)."""
 
-    embed: Any  # (head_params, ids[B,S], positions[B,S]) -> h[B,S,H]
     stage: Any  # (cfg, layers, h, cache, positions, mask) -> (h, cache)
-    logits: Any  # (cfg, head_params, h) -> [B,S,V]
 
 
 def model_fns(cfg: ModelConfig) -> ModelFns:
     if cfg.model_type == "llama":
-        return ModelFns(
-            embed=lambda hp, ids, pos: llama.embed(hp, ids),
-            stage=llama.forward_layers,
-            logits=llama.final_logits,
-        )
+        return ModelFns(stage=llama.forward_layers)
     elif cfg.model_type == "gpt2":
-        return ModelFns(
-            embed=lambda hp, ids, pos: gpt2.embed(hp, ids, pos),
-            stage=gpt2.forward_layers,
-            logits=gpt2.final_logits,
-        )
+        return ModelFns(stage=gpt2.forward_layers)
     raise ValueError(f"unsupported model_type: {cfg.model_type!r}")
 
 
@@ -123,6 +128,15 @@ def check_stage_shapes(layer_masks, num_stages: int) -> None:
         )
 
 
+def ensure_sharded_head(cfg: ModelConfig, head_params, num_stages: int):
+    """Host-boundary convenience: accept either a full (unsharded) head dict
+    or one already stacked by ``shard_head_host``. Hot paths (the engine)
+    pre-shard once per placement; tests/dryruns may pass the full head."""
+    if is_sharded_head(head_params):
+        return head_params
+    return shard_head_host(cfg, head_params, num_stages)
+
+
 class PipelineResult(NamedTuple):
     tokens: np.ndarray  # [B, S + max_new_tokens]
     lengths: np.ndarray  # [B]
@@ -139,7 +153,7 @@ def _pipeline_generate_jit(
     mesh: Mesh,
     stage_layers: Any,  # leaves [num_stages, Lp, ...]
     layer_masks: jnp.ndarray,  # [num_stages, Lp]
-    head_params: Any,  # replicated: embed / pos_embed? / final_norm(+bias) / lm_head
+    head_params: Any,  # vocab-sharded head (see parallel/head.py)
     prompt: jnp.ndarray,  # [B, S]
     prompt_len: jnp.ndarray,  # [B]
     num_stages: int,
@@ -157,6 +171,7 @@ def _pipeline_generate_jit(
         # Local views: shard_map gives leading stage dim of 1 — drop it.
         layers = jax.tree.map(lambda a: a[0], stage_layers)
         mask = layer_mask[0]
+        hd = local_view(head_params)
         sidx = jax.lax.axis_index(PIPE_AXIS)
 
         cache = KVCache(
@@ -183,31 +198,27 @@ def _pipeline_generate_jit(
         positions = jnp.where(
             idx[None, :] < prompt_len[:, None], idx[None, :], POS_SENTINEL
         )
-        h = fns.embed(head_params, prompt, positions)
+        h = sp_embed(cfg, hd, prompt, positions)
         h, cache = chain(h, cache, positions)
-        # The fully-processed block has landed back on stage 0.
-        logits = fns.logits(cfg, head_params, h)
-        last = jnp.take_along_axis(logits, (prompt_len - 1)[:, None, None], axis=1)[
+        # The fully-processed block has landed back on stage 0; pull its
+        # last real position and broadcast so every stage can project its
+        # vocab slice.
+        h_last = jnp.take_along_axis(h, (prompt_len - 1)[:, None, None], axis=1)[
             :, 0
         ]
-        tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        h_last = psum_from(h_last, 0)
+        tok = sp_next_token(cfg, hd, h_last)  # [B], replicated
 
         out = jnp.zeros((B, total), jnp.int32)
         out = jax.lax.dynamic_update_slice(out, prompt, (0, 0))
         out = out.at[jnp.arange(B), prompt_len].set(tok)
         done = _is_stop(cfg, tok)
-        # Sync the stop decision from stage 0 to the whole ring (in-program
-        # analogue of the clear-KV ring command, node_worker.py:507-513).
-        done = (
-            jax.lax.psum(
-                jnp.where(sidx == 0, done.astype(jnp.int32), 0), PIPE_AXIS
-            )
-            > 0
-        )
         lengths = prompt_len + 1
 
         # ---- decode (≙ receive_next_token → re-embed → chain traversal,
-        # node_worker.py:275-309) ----
+        # node_worker.py:275-309). All bookkeeping is replicated — every
+        # stage derived the same token — so the loop predicate is uniform
+        # without a stop-broadcast collective. ----
         state = dict(
             out=out, tok=tok, pos=prompt_len, done=done, cache=cache,
             lengths=lengths, n=jnp.ones((), jnp.int32),
@@ -218,21 +229,15 @@ def _pipeline_generate_jit(
 
         def step(s):
             tok_pos = s["pos"][:, None]
-            h = fns.embed(head_params, s["tok"][:, None], tok_pos)
+            h = sp_embed(cfg, hd, s["tok"][:, None], tok_pos)
             h, cache = chain(h, s["cache"], tok_pos)
-            logits = fns.logits(cfg, head_params, h)[:, 0]
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            h_last = psum_from(h[:, 0], 0)
+            nxt = sp_next_token(cfg, hd, h_last)
             nxt = jnp.where(s["done"], 0, nxt)
             new_pos = s["pos"] + 1
             out = s["out"].at[jnp.arange(B), new_pos].set(nxt)
             out = jnp.where(s["done"][:, None], s["out"], out)
             done = s["done"] | _is_stop(cfg, nxt)
-            done = (
-                jax.lax.psum(
-                    jnp.where(sidx == 0, done.astype(jnp.int32), 0), PIPE_AXIS
-                )
-                > 0
-            )
             return dict(
                 out=out,
                 tok=nxt,
@@ -244,19 +249,18 @@ def _pipeline_generate_jit(
             )
 
         state = jax.lax.while_loop(cond, step, state)
-
-        # Broadcast stage 0's results to all devices so outputs are replicated.
-        def bcast(x):
-            return jax.lax.psum(
-                jnp.where(sidx == 0, x, jnp.zeros_like(x)), PIPE_AXIS
-            )
-
-        return bcast(state["out"]), bcast(state["lengths"])
+        return state["out"], state["lengths"]
 
     out, lengths = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(PIPE_AXIS), P(PIPE_AXIS), P(), P(), P()),
+        in_specs=(
+            P(PIPE_AXIS),
+            P(PIPE_AXIS),
+            head_specs(head_params),
+            P(),
+            P(),
+        ),
         out_specs=(P(), P()),
         check_vma=False,
     )(stage_layers, layer_masks, head_params, prompt, prompt_len)
@@ -289,6 +293,7 @@ def pipeline_generate(
     capacity = validate_request(cfg, S, max_new_tokens, capacity)
     num_stages = mesh.shape[PIPE_AXIS]
     check_stage_shapes(layer_masks, num_stages)
+    head_params = ensure_sharded_head(cfg, head_params, num_stages)
 
     out, lengths = _pipeline_generate_jit(
         cfg,

@@ -5,26 +5,33 @@ The reference keeps exactly one token in flight: while a token is on stage s,
 every other stage idles (``/root/reference/utils/node_worker.py:493-547``;
 SURVEY.md §3.2 "no overlap of communication and compute anywhere"). That caps
 chain throughput at (1 token) / (S stage-times). This scheduler runs
-``num_stages`` independent requests in flight, round-robin: at every
-microstep, each device computes a *different* request's block, then the ring
+``num_stages`` independent request *slots* in flight, round-robin: at every
+microstep, each device computes a *different* slot's block, then the ring
 permutes — so every stage does useful work every microstep and aggregate
 throughput approaches one token per stage-time, an S× improvement that is the
 mechanism behind the ≥100 tok/s v5e-8 headline target (BASELINE.md;
-SURVEY.md §7 "hard parts": microbatched decode).
+SURVEY.md §7 "hard parts": microbatched decode). Each slot additionally
+carries ``batch_per_slot`` independent requests decoded as one batched block
+— per-microstep work becomes a [Bs,·] matmul instead of a matvec, multiplying
+aggregate throughput again at near-constant microstep latency.
 
-Schedule (S = num_stages, request slot r, microstep m):
+Schedule (S = num_stages, slot r, microstep m):
 - device d serves slot r = (m − d) mod S;
-- a completed token (device S−1) is immediately re-embedded there and sent to
-  stage 0 through the same ring permute that carries hidden blocks — the
+- the completed block surfaces on device S−1; the next token for each of its
+  rows is assembled via the vocab-sharded head (``parallel/head.py`` — each
+  stage projects only its V/S logit slice), so every stage learns the token
+  and bookkeeping (EOS/done/lengths/output) is fully replicated — no
+  stop-broadcast collective;
+- the new token is re-embedded (vocab-parallel psum) and device S−1 sends it
+  to stage 0 through the same ring permute that carries hidden blocks — the
   reference's token-return hop (``node_worker.py:515-525``) fused into the
   steady-state schedule;
-- prefill runs all S requests as one batched sequential chain traversal
+- prefill runs all S·Bs requests as one batched sequential chain traversal
   (caches fill in a single trip), then the decode wavefront ramps in over the
   first S microsteps (validity-masked), runs steady, and drains.
 
-Per-device KV caches hold all S slots ([Lp, S·B, C, ...]); each microstep
-touches only the served slot via dynamic slicing. EOS/done bookkeeping lives
-on the last stage and is psum-broadcast for the uniform while_loop predicate.
+Per-device KV caches hold all S·Bs rows ([Lp, S·Bs, C, ...]); each microstep
+touches only the served slot's rows via dynamic slicing.
 """
 
 from __future__ import annotations
@@ -40,13 +47,20 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models.cache import KVCache, POS_SENTINEL
 from ..models.config import ModelConfig
 from ..ops.sampling import is_stop as _is_stop
+from .head import head_specs, local_view, psum_from, sp_embed, sp_next_token
 from .mesh import PIPE_AXIS
-from .pipeline import check_stage_shapes, model_fns, ring_chain, validate_request
+from .pipeline import (
+    check_stage_shapes,
+    ensure_sharded_head,
+    model_fns,
+    ring_chain,
+    validate_request,
+)
 
 
 class InterleavedResult(NamedTuple):
-    tokens: np.ndarray  # [M, S + max_new_tokens]
-    lengths: np.ndarray  # [M]
+    tokens: np.ndarray  # [R, S + max_new_tokens]
+    lengths: np.ndarray  # [R]
 
 
 @functools.partial(
@@ -61,9 +75,9 @@ def _interleaved_jit(
     stage_layers: Any,
     layer_masks: jnp.ndarray,
     head_params: Any,
-    prompts: jnp.ndarray,  # [M, S] right-padded, M == num_stages slots
+    prompts: jnp.ndarray,  # [M, S] right-padded, M == num_stages * Bs rows
     prompt_len: jnp.ndarray,  # [M]
-    slot_valid: jnp.ndarray,  # [M] bool — False for padding slots
+    slot_valid: jnp.ndarray,  # [M] bool — False for padding rows
     num_stages: int,
     max_new_tokens: int,
     capacity: int,
@@ -71,6 +85,7 @@ def _interleaved_jit(
 ):
     fns = model_fns(cfg)
     M, S = prompts.shape
+    Bs = M // num_stages  # rows per slot
     total = S + max_new_tokens
     Lp = layer_masks.shape[1]
     ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
@@ -79,9 +94,10 @@ def _interleaved_jit(
     def body(stage_layers, layer_mask, head_params, prompts, prompt_len, slot_valid):
         layers = jax.tree.map(lambda a: a[0], stage_layers)
         lmask = layer_mask[0]
+        hd = local_view(head_params)
         sidx = jax.lax.axis_index(PIPE_AXIS)
 
-        # ---- batched prefill: all M requests in one chain traversal ----
+        # ---- batched prefill: all M rows in one chain traversal ----
         cache = KVCache(
             k=jnp.zeros((Lp, M, capacity, cfg.num_key_value_heads, cfg.head_dim_), cache_dtype),
             v=jnp.zeros((Lp, M, capacity, cfg.num_key_value_heads, cfg.head_dim_), cache_dtype),
@@ -92,20 +108,17 @@ def _interleaved_jit(
         positions = jnp.where(
             idx[None, :] < prompt_len[:, None], idx[None, :], POS_SENTINEL
         )
-        h = fns.embed(head_params, prompts, positions)
+        h = sp_embed(cfg, hd, prompts, positions)
         h, cache = ring_chain(
             fns, cfg, layers, lmask, sidx, ring, num_stages, h, cache, positions
         )
-        # full-depth block landed on stage 0
-        logits = fns.logits(cfg, head_params, h)
-        first_last = jnp.take_along_axis(
-            logits, (prompt_len - 1)[:, None, None], axis=1
+        # full-depth block landed on stage 0; assemble the first token for
+        # every row via the sharded head (replicated result).
+        h_last = jnp.take_along_axis(
+            h, (prompt_len - 1)[:, None, None], axis=1
         )[:, 0]
-        tok0 = jnp.argmax(first_last, axis=-1).astype(jnp.int32)  # [M], valid @ stage 0
-
-        # Every stage needs tok0 (stage 0 injects from it during ramp-in) and
-        # the out/done bookkeeping starts from it on the LAST stage.
-        tok0 = jax.lax.psum(jnp.where(sidx == 0, tok0, 0), PIPE_AXIS)
+        h_last = psum_from(h_last, 0)
+        tok0 = sp_next_token(cfg, hd, h_last)  # [M], replicated
 
         out = jnp.zeros((M, total), jnp.int32)
         out = jax.lax.dynamic_update_slice(out, prompts, (0, 0))
@@ -115,32 +128,32 @@ def _interleaved_jit(
         done0 = (_is_stop(cfg, tok0) | ~slot_valid)
         lengths = jnp.where(slot_valid, prompt_len + 1, prompt_len)
 
+        # Ramp-in injections: stage 0's first serve of slot r feeds tok0's
+        # embedding — precomputed here (replicated) so the steady-state loop
+        # carries no extra embed collective for it.
+        inject_all = sp_embed(cfg, hd, tok0[:, None], prompt_len[:, None])
+
         # ---- interleaved decode ----
-        # Per-device per-slot position of the slot's current token.
+        # Per-device per-row position of the row's current token.
         pos_slots = prompt_len  # [M]
+        # per-slot cache write offset (shared by the slot's rows; prefill
+        # wrote [0, S))
+        write_off = jnp.full((num_stages,), S, jnp.int32)
 
-        # decode cache: after prefill, cache.length == S (shared write offset);
-        # slot writes now advance independently per serve via per-slot offset.
-        # We carry a per-slot write offset ([M]) starting at S.
-        write_off = jnp.full((M,), S, jnp.int32)
-
-        # tok0 (from prefill) is generated token #1; each slot needs
+        # tok0 (from prefill) is generated token #1; each row needs
         # max_new_tokens - 1 more completions, one per ring cycle. Slot r's
         # last completion happens at microstep r + (S-1) + (max_new-2)·S, so
         # the drain needs S·max_new − 1 microsteps for the last slot.
         total_micro = num_stages * max_new_tokens - 1
 
-        # The resident activation per device is ONE request's single-token
-        # block; stage 0 injects the first real one during ramp-in.
         state = dict(
-            h=jnp.zeros((1, 1, cfg.hidden_size), h.dtype),
+            h=jnp.zeros((Bs, 1, cfg.hidden_size), h.dtype),
             cache=cache,
             out=out,
             done=done0,
             lengths=lengths,
             pos_slots=pos_slots,
             write_off=write_off,
-            tok0=tok0,
             m=jnp.zeros((), jnp.int32),
         )
 
@@ -150,36 +163,34 @@ def _interleaved_jit(
         def micro(s):
             m = s["m"]
             r = jnp.mod(m - sidx, num_stages)  # slot this device serves
+            row0 = r * Bs
             ramp_in = m < num_stages  # wavefront not yet arrived everywhere
             valid = m >= sidx  # device has real data from m == sidx onward
 
-            pos_r = jax.lax.dynamic_index_in_dim(s["pos_slots"], r, keepdims=False)
+            pos_rows = jax.lax.dynamic_slice_in_dim(s["pos_slots"], row0, Bs)
             off_r = jax.lax.dynamic_index_in_dim(s["write_off"], r, keepdims=False)
 
             # stage 0 self-injects the slot's first decode embedding during
-            # ramp-in (token tok0[r] at position pos_r)
-            tok_r = jax.lax.dynamic_index_in_dim(s["tok0"], r, keepdims=False)
-            inject = fns.embed(
-                head_params, tok_r[None, None], pos_r[None, None]
-            )
+            # ramp-in (precomputed above)
+            inject = jax.lax.dynamic_slice_in_dim(inject_all, row0, Bs, axis=0)
             h_in = jnp.where((sidx == 0) & ramp_in, inject, s["h"])
 
             # slice this slot's cache rows
             cache_r = KVCache(
-                k=jax.lax.dynamic_slice_in_dim(s["cache"].k, r, 1, axis=1),
-                v=jax.lax.dynamic_slice_in_dim(s["cache"].v, r, 1, axis=1),
-                pos=jax.lax.dynamic_slice_in_dim(s["cache"].pos, r, 1, axis=0),
+                k=jax.lax.dynamic_slice_in_dim(s["cache"].k, row0, Bs, axis=1),
+                v=jax.lax.dynamic_slice_in_dim(s["cache"].v, row0, Bs, axis=1),
+                pos=jax.lax.dynamic_slice_in_dim(s["cache"].pos, row0, Bs, axis=0),
                 length=off_r,
             )
             h_new, cache_r_new = fns.stage(
-                cfg, layers, h_in, cache_r, pos_r[None, None], lmask
+                cfg, layers, h_in, cache_r, pos_rows[:, None], lmask
             )
             # Commit the slot cache UNCONDITIONALLY — a ramp-in garbage write
             # lands at the same offset the first valid serve will overwrite
             # (write_off only advances on valid serves), and nothing reads the
             # slot in between. This avoids a full-cache select per microstep.
             def upd(big, small, axis):
-                return jax.lax.dynamic_update_slice_in_dim(big, small, r, axis=axis)
+                return jax.lax.dynamic_update_slice_in_dim(big, small, row0, axis=axis)
 
             cache = KVCache(
                 k=upd(s["cache"].k, cache_r_new.k, 1),
@@ -191,45 +202,43 @@ def _interleaved_jit(
                 valid, s["write_off"].at[r].add(1), s["write_off"]
             )
 
-            # last stage: complete the token
-            logits = fns.logits(cfg, head_params, h_new)[:, 0]  # [1, V]
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
-            done_r = jax.lax.dynamic_index_in_dim(s["done"], r, keepdims=False)
-            nxt = jnp.where(done_r, 0, nxt)
+            # ---- token completion for the slot the LAST stage just served.
+            # The completed block is broadcast; the vocab-sharded head
+            # assembles the next token on every stage, so all bookkeeping
+            # below is replicated (identical on every device).
+            r_done = jnp.mod(m - last, num_stages)
+            rowd = r_done * Bs
+            row_ids = rowd + jnp.arange(Bs, dtype=jnp.int32)
+            valid_done = m >= last
 
-            is_last = sidx == last
-            len_r = jax.lax.dynamic_index_in_dim(s["lengths"], r, keepdims=False)
-            plen_r = jax.lax.dynamic_index_in_dim(prompt_len, r, keepdims=False)
-            under_budget = (len_r - plen_r) < max_new_tokens
-            commit_tok = is_last & valid & ~done_r & under_budget
-            out = jnp.where(
-                commit_tok,
-                s["out"].at[r, pos_r + 1].set(nxt),
-                s["out"],
-            )
-            lengths = jnp.where(
-                commit_tok, s["lengths"].at[r].add(1), s["lengths"]
-            )
-            newly_done = commit_tok & _is_stop(cfg, nxt[None])[0]
-            done = jnp.where(newly_done, s["done"].at[r].set(True), s["done"])
-            # broadcast done from the last stage for a uniform predicate
-            done = (
-                jax.lax.psum(
-                    jnp.where(sidx == last, done.astype(jnp.int32), 0), PIPE_AXIS
-                )
-                > 0
+            h_done = psum_from(h_new[:, 0], last)  # [Bs, H]
+            nxt = sp_next_token(cfg, hd, h_done)  # [Bs], replicated
+            done_rows = jax.lax.dynamic_slice_in_dim(s["done"], rowd, Bs)
+            nxt = jnp.where(done_rows, 0, nxt)
+
+            len_rows = jax.lax.dynamic_slice_in_dim(s["lengths"], rowd, Bs)
+            plen_rows = jax.lax.dynamic_slice_in_dim(prompt_len, rowd, Bs)
+            under_budget = (len_rows - plen_rows) < max_new_tokens
+            commit = valid_done & ~done_rows & under_budget  # [Bs]
+            wpos = len_rows  # next token's sequence index per row
+            cur = s["out"][row_ids, wpos]
+            out = s["out"].at[row_ids, wpos].set(jnp.where(commit, nxt, cur))
+            lengths = s["lengths"].at[row_ids].add(commit.astype(jnp.int32))
+            done = s["done"].at[row_ids].set(
+                done_rows | (commit & _is_stop(cfg, nxt))
             )
 
-            # last stage re-embeds its freshly-made token for the ring
-            h_send = jnp.where(
-                is_last,
-                fns.embed(head_params, nxt[None, None], (pos_r + 1)[None, None]),
-                h_new,
-            )
+            # re-embed the fresh tokens (vocab-parallel, replicated result);
+            # only the last stage sends them around the ring
+            h_embed = sp_embed(cfg, hd, nxt[:, None], wpos[:, None])
+            h_send = jnp.where(sidx == last, h_embed, h_new)
             h_out = jax.lax.ppermute(h_send, PIPE_AXIS, ring)
 
             # this device will see slot r again in S microsteps, one token deeper
-            pos_slots = jnp.where(valid, s["pos_slots"].at[r].add(1), s["pos_slots"])
+            served_rows = row0 + jnp.arange(Bs, dtype=jnp.int32)
+            pos_slots = jnp.where(
+                valid, s["pos_slots"].at[served_rows].add(1), s["pos_slots"]
+            )
 
             return dict(
                 h=h_out,
@@ -239,23 +248,23 @@ def _interleaved_jit(
                 lengths=lengths,
                 pos_slots=pos_slots,
                 write_off=write_off,
-                tok0=s["tok0"],
                 m=m + 1,
             )
 
         state = jax.lax.while_loop(cond, micro, state)
-
-        def bcast_last(x):
-            return jax.lax.psum(
-                jnp.where(sidx == last, x, jnp.zeros_like(x)), PIPE_AXIS
-            )
-
-        return bcast_last(state["out"]), bcast_last(state["lengths"])
+        return state["out"], state["lengths"]
 
     out, lengths = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(PIPE_AXIS), P(PIPE_AXIS), P(), P(), P(), P()),
+        in_specs=(
+            P(PIPE_AXIS),
+            P(PIPE_AXIS),
+            head_specs(head_params),
+            P(),
+            P(),
+            P(),
+        ),
         out_specs=(P(), P()),
         check_vma=False,
     )(stage_layers, layer_masks, head_params, prompts, prompt_len, slot_valid)
@@ -268,39 +277,46 @@ def interleaved_generate(
     stage_layers: Any,
     layer_masks: jnp.ndarray,
     head_params: Any,
-    prompts,  # [M, S] with M <= num_stages (padded to num_stages slots)
+    prompts,  # [R, S] with R <= num_stages * batch_per_slot
     max_new_tokens: int = 128,
     *,
     prompt_len=None,
     capacity: Optional[int] = None,
+    batch_per_slot: Optional[int] = None,
     cache_dtype=jnp.bfloat16,
 ) -> InterleavedResult:
-    """Generate for up to ``num_stages`` requests concurrently, pipeline full."""
+    """Generate for up to ``num_stages * batch_per_slot`` requests
+    concurrently, pipeline full. ``batch_per_slot`` defaults to the smallest
+    value that fits all R requests."""
     prompts = jnp.asarray(prompts, jnp.int32)
     if prompts.ndim == 1:
         prompts = prompts[None]
-    M, S = prompts.shape
+    R, S = prompts.shape
     num_stages = mesh.shape[PIPE_AXIS]
-    if M > num_stages:
+    if batch_per_slot is None:
+        batch_per_slot = max(1, -(-R // num_stages))
+    M = num_stages * batch_per_slot
+    if R > M:
         raise ValueError(
-            f"{M} requests > {num_stages} pipeline slots; batch into groups "
-            f"of {num_stages}"
+            f"{R} requests > {M} rows (num_stages={num_stages} × "
+            f"batch_per_slot={batch_per_slot}); batch into groups of {M}"
         )
     if prompt_len is None:
-        prompt_len = jnp.full((M,), S, jnp.int32)
+        prompt_len = jnp.full((R,), S, jnp.int32)
     else:
         prompt_len = jnp.asarray(prompt_len, jnp.int32)
 
     capacity = validate_request(cfg, S, max_new_tokens, capacity)
     check_stage_shapes(layer_masks, num_stages)
+    head_params = ensure_sharded_head(cfg, head_params, num_stages)
 
-    slot_valid = np.zeros((num_stages,), bool)
-    slot_valid[:M] = True
-    if M < num_stages:  # pad slots with dummy single-token prompts
-        pad = np.zeros((num_stages - M, S), np.int32)
+    slot_valid = np.zeros((M,), bool)
+    slot_valid[:R] = True
+    if R < M:  # pad rows with dummy single-token prompts
+        pad = np.zeros((M - R, S), np.int32)
         prompts = jnp.concatenate([prompts, jnp.asarray(pad)], axis=0)
         prompt_len = jnp.concatenate(
-            [prompt_len, jnp.ones((num_stages - M,), jnp.int32)], axis=0
+            [prompt_len, jnp.ones((M - R,), jnp.int32)], axis=0
         )
 
     out, lengths = _interleaved_jit(
@@ -317,4 +333,4 @@ def interleaved_generate(
         capacity,
         cache_dtype,
     )
-    return InterleavedResult(np.asarray(out)[:M], np.asarray(lengths)[:M])
+    return InterleavedResult(np.asarray(out)[:R], np.asarray(lengths)[:R])

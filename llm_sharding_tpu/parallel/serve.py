@@ -1,0 +1,410 @@
+"""Persistent interleaved-decode programs: admit / chunk, with state carried
+across calls — the compute side of continuous batching.
+
+The reference's serving story is a daemon: ``run_worker_loop`` accepts
+requests forever, one at a time (``/root/reference/utils/node_worker.py:
+493-559``). Round 1's ``interleaved_generate`` is call-and-return: membership
+is fixed at program start and finished slots idle until the full drain. This
+module closes that gap the TPU way — the interleaved schedule's device state
+(per-stage KV caches, in-flight ring blocks, per-slot offsets) becomes an
+explicit ``ServeState`` pytree that round-trips between three jitted
+``shard_map`` programs:
+
+- ``serve_admit``: prefill ONE slot's rows (a ring traversal writing that
+  slot's cache rows on every stage) while other slots stay mid-decode —
+  the dynamic-admission analogue of ``receive_user_request``
+  (``node_worker.py:188-224``). The slot's first decode embedding is
+  precomputed and parked in ``inject``; stage 0 consumes it the next time
+  the schedule hands it that slot.
+- ``serve_chunk``: run a fixed number of interleaved microsteps
+  (``lax.fori_loop`` — fixed trip count, one compiled program reused for the
+  server's lifetime). Bookkeeping (tokens, lengths, done) is replicated via
+  the vocab-sharded head (see ``schedule.py``), so the host reads results
+  with a cheap fetch after each chunk and can stream tokens per ring cycle.
+- block validity travels WITH the ring: each device carries an ``h_valid``
+  bit for the block it holds, permuted alongside it, so freshly admitted
+  slots ramp in correctly no matter where the schedule phase stands (the
+  generalization of the one-shot program's ``m >= sidx`` wavefront).
+
+The host-side queue/daemon that drives these programs lives in
+``runtime/server.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.cache import KVCache, POS_SENTINEL
+from ..models.config import ModelConfig
+from ..ops.sampling import is_stop as _is_stop
+from .head import head_specs, local_view, psum_from, sp_embed, sp_next_token
+from .mesh import PIPE_AXIS
+from .pipeline import model_fns, ring_chain
+
+
+class ServeState(NamedTuple):
+    """Device state of a live interleaved pipeline between program calls.
+
+    Leaves marked [dev] differ per device (sharded over the pipe axis with a
+    leading stage dim); the rest are replicated bookkeeping.
+    """
+
+    k: jax.Array          # [dev] [S, Lp, M, C, Nkv, Dh]
+    v: jax.Array          # [dev] [S, Lp, M, C, Nkv, Dh]
+    kpos: jax.Array       # [dev] [S, M, C] key positions / sentinel
+    h: jax.Array          # [dev] [S, Bs, 1, H] in-flight ring block
+    h_valid: jax.Array    # [dev] [S] bool — the held block is real data
+    pos_slots: jax.Array  # [dev] [S, M] this device's view of row positions
+    write_off: jax.Array  # [dev] [S, num_slots] per-slot cache write offset
+    out: jax.Array        # [M, OUT_CAP] int32 token buffer (prompt + gen)
+    lengths: jax.Array    # [M] valid length per row
+    done: jax.Array       # [M] bool
+    budget: jax.Array     # [M] max total length (prompt + max_new) per row
+    inject: jax.Array     # [M, 1, H] pending stage-0 injection embeddings
+    inject_pending: jax.Array  # [M] bool
+    m: jax.Array          # scalar int32 microstep counter
+
+
+def state_specs(state: ServeState) -> ServeState:
+    dev = P(PIPE_AXIS)
+    rep = P()
+    return ServeState(
+        k=dev, v=dev, kpos=dev, h=dev, h_valid=dev, pos_slots=dev,
+        write_off=dev, out=rep, lengths=rep, done=rep, budget=rep,
+        inject=rep, inject_pending=rep, m=rep,
+    )
+
+
+def make_state(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    layers_per_stage: int,
+    *,
+    capacity: int,
+    batch_per_slot: int = 1,
+    cache_dtype=jnp.bfloat16,
+    act_dtype=jnp.bfloat16,
+) -> ServeState:
+    """Host-constructed empty state (all slots free / done)."""
+    S = mesh.shape[PIPE_AXIS]
+    Bs = batch_per_slot
+    M = S * Bs
+    Lp = layers_per_stage
+    C = capacity
+    H = cfg.hidden_size
+    dev = NamedSharding(mesh, P(PIPE_AXIS))
+    rep = NamedSharding(mesh, P())
+
+    def put(arr, sh):
+        return jax.device_put(arr, sh)
+
+    kv_shape = (S, Lp, M, C, cfg.num_key_value_heads, cfg.head_dim_)
+    state = ServeState(
+        k=put(jnp.zeros(kv_shape, cache_dtype), dev),
+        v=put(jnp.zeros(kv_shape, cache_dtype), dev),
+        kpos=put(jnp.full((S, M, C), POS_SENTINEL, jnp.int32), dev),
+        h=put(jnp.zeros((S, Bs, 1, H), act_dtype), dev),
+        h_valid=put(jnp.zeros((S,), jnp.bool_), dev),
+        pos_slots=put(jnp.zeros((S, M), jnp.int32), dev),
+        write_off=put(jnp.zeros((S, S), jnp.int32), dev),
+        out=put(jnp.zeros((M, C), jnp.int32), rep),
+        lengths=put(jnp.zeros((M,), jnp.int32), rep),
+        done=put(jnp.ones((M,), jnp.bool_), rep),
+        budget=put(jnp.zeros((M,), jnp.int32), rep),
+        inject=put(jnp.zeros((M, 1, H), act_dtype), rep),
+        inject_pending=put(jnp.zeros((M,), jnp.bool_), rep),
+        m=put(jnp.zeros((), jnp.int32), rep),
+    )
+    return state
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "mesh", "num_stages", "cache_dtype")
+)
+def serve_admit(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    stage_layers: Any,
+    layer_masks: jnp.ndarray,
+    head_params: Any,  # vocab-sharded
+    state: ServeState,
+    prompts: jnp.ndarray,     # [Bs, Sp] right-padded (Sp = admission bucket)
+    prompt_len: jnp.ndarray,  # [Bs]
+    row_valid: jnp.ndarray,   # [Bs] bool — False rows stay free/done
+    slot: jnp.ndarray,        # scalar int32
+    max_new: jnp.ndarray,     # [Bs] per-row new-token budget
+    num_stages: int,
+    cache_dtype,
+):
+    """Prefill ``slot`` with up to Bs new requests while the rest of the
+    pipeline state is parked. Returns the updated state."""
+    fns = model_fns(cfg)
+    Bs, Sp = prompts.shape
+    ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+    C = state.out.shape[1]
+
+    def body(stage_layers, layer_mask, head_params, state, prompts,
+             prompt_len, row_valid, slot, max_new):
+        layers = jax.tree.map(lambda a: a[0], stage_layers)
+        lmask = layer_mask[0]
+        hd = local_view(head_params)
+        sidx = jax.lax.axis_index(PIPE_AXIS)
+        st = jax.tree.map(
+            lambda spec, leaf: leaf[0] if spec == P(PIPE_AXIS) else leaf,
+            state_specs(state), state,
+        )
+        row0 = slot * Bs
+
+        # fresh cache rows for this slot only
+        Lp = lmask.shape[0]
+        kv_shape = (Lp, Bs, C, cfg.num_key_value_heads, cfg.head_dim_)
+        cache = KVCache(
+            k=jnp.zeros(kv_shape, cache_dtype),
+            v=jnp.zeros(kv_shape, cache_dtype),
+            pos=jnp.full((Bs, C), POS_SENTINEL, jnp.int32),
+            length=jnp.zeros((), jnp.int32),
+        )
+        idx = jnp.arange(Sp, dtype=jnp.int32)
+        positions = jnp.where(
+            idx[None, :] < prompt_len[:, None], idx[None, :], POS_SENTINEL
+        )
+        h = sp_embed(cfg, hd, prompts, positions)
+        h, cache = ring_chain(
+            fns, cfg, layers, lmask, sidx, ring, num_stages, h, cache, positions
+        )
+        h_last = jnp.take_along_axis(
+            h, (prompt_len - 1)[:, None, None], axis=1
+        )[:, 0]
+        h_last = psum_from(h_last, 0)
+        tok0 = sp_next_token(cfg, hd, h_last)  # [Bs] replicated
+        tok0 = jnp.where(row_valid, tok0, 0)
+
+        # ---- scatter the slot into the parked state ----
+        k_new = jax.lax.dynamic_update_slice_in_dim(st.k, cache.k, row0, axis=1)
+        v_new = jax.lax.dynamic_update_slice_in_dim(st.v, cache.v, row0, axis=1)
+        kpos_new = jax.lax.dynamic_update_slice_in_dim(
+            st.kpos, cache.pos, row0, axis=0
+        )
+        pos_slots = jax.lax.dynamic_update_slice_in_dim(
+            st.pos_slots, prompt_len, row0, axis=0
+        )
+        write_off = st.write_off.at[slot].set(Sp)
+
+        rows = row0 + jnp.arange(Bs, dtype=jnp.int32)
+        out_rows = jnp.zeros((Bs, C), jnp.int32)
+        out_rows = jax.lax.dynamic_update_slice(out_rows, prompts, (0, 0))
+        out_rows = out_rows.at[jnp.arange(Bs), prompt_len].set(tok0)
+        out = jax.lax.dynamic_update_slice_in_dim(st.out, out_rows, row0, axis=0)
+
+        lengths = jax.lax.dynamic_update_slice_in_dim(
+            st.lengths, jnp.where(row_valid, prompt_len + 1, 0), row0, axis=0
+        )
+        budget = jax.lax.dynamic_update_slice_in_dim(
+            st.budget, jnp.where(row_valid, prompt_len + max_new, 0), row0,
+            axis=0,
+        )
+        done0 = _is_stop(cfg, tok0) | ~row_valid | (max_new <= 1)
+        done = jax.lax.dynamic_update_slice_in_dim(st.done, done0, row0, axis=0)
+
+        inj = sp_embed(cfg, hd, tok0[:, None], prompt_len[:, None])  # [Bs,1,H]
+        inject = jax.lax.dynamic_update_slice_in_dim(
+            st.inject, inj.astype(st.inject.dtype), row0, axis=0
+        )
+        inject_pending = jax.lax.dynamic_update_slice_in_dim(
+            st.inject_pending, row_valid & ~done0, row0, axis=0
+        )
+
+        # Defense in depth vs stale parked blocks: the device whose next
+        # microstep serves this slot currently holds a block belonging to it
+        # (dead — the slot was free); mark it invalid so the injection path
+        # is the only way the new request's data enters the ring.
+        next_served = jnp.mod(st.m - sidx, num_stages)
+        h_valid = jnp.where(next_served == slot, False, st.h_valid)
+
+        new = st._replace(
+            k=k_new, v=v_new, kpos=kpos_new, pos_slots=pos_slots,
+            write_off=write_off, out=out, lengths=lengths, budget=budget,
+            done=done, inject=inject, inject_pending=inject_pending,
+            h_valid=h_valid,
+        )
+        return jax.tree.map(
+            lambda spec, leaf: leaf[None] if spec == P(PIPE_AXIS) else leaf,
+            state_specs(state), new,
+        )
+
+    specs = state_specs(ServeState(*([None] * len(ServeState._fields))))
+    out_state = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(PIPE_AXIS), P(PIPE_AXIS), head_specs(head_params), specs,
+            P(), P(), P(), P(), P(),
+        ),
+        out_specs=specs,
+        check_vma=False,
+    )(stage_layers, layer_masks, head_params, state, prompts, prompt_len,
+      row_valid, slot, max_new)
+    return out_state
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "mesh", "num_stages", "n_micro"),
+)
+def serve_chunk(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    stage_layers: Any,
+    layer_masks: jnp.ndarray,
+    head_params: Any,
+    state: ServeState,
+    num_stages: int,
+    n_micro: int,
+):
+    """Run ``n_micro`` interleaved microsteps on the live state."""
+    fns = model_fns(cfg)
+    ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+    last = num_stages - 1
+    M = state.out.shape[0]
+    Bs = M // num_stages
+
+    def body(stage_layers, layer_mask, head_params, state):
+        layers = jax.tree.map(lambda a: a[0], stage_layers)
+        lmask = layer_mask[0]
+        hd = local_view(head_params)
+        sidx = jax.lax.axis_index(PIPE_AXIS)
+        st = jax.tree.map(
+            lambda spec, leaf: leaf[0] if spec == P(PIPE_AXIS) else leaf,
+            state_specs(state), state,
+        )
+
+        def micro(_, s: ServeState) -> ServeState:
+            m = s.m
+            r = jnp.mod(m - sidx, num_stages)
+            row0 = r * Bs
+            served_rows = row0 + jnp.arange(Bs, dtype=jnp.int32)
+
+            pos_rows = jax.lax.dynamic_slice_in_dim(s.pos_slots, row0, Bs)
+            off_r = jax.lax.dynamic_index_in_dim(
+                s.write_off, r, keepdims=False
+            )
+            done_served = jax.lax.dynamic_slice_in_dim(s.done, row0, Bs)
+            pend_rows = jax.lax.dynamic_slice_in_dim(
+                s.inject_pending, row0, Bs
+            )
+            inj_rows = jax.lax.dynamic_slice_in_dim(s.inject, row0, Bs, axis=0)
+
+            # stage 0 consumes a pending injection for this slot; the block
+            # becomes valid data. (Whole-slot admission → pend uniform.)
+            injecting = (sidx == 0) & jnp.any(pend_rows)
+            h_in = jnp.where(injecting, inj_rows.astype(s.h.dtype), s.h)
+            valid_now = injecting | s.h_valid
+            slot_active = ~jnp.all(done_served)
+            advance = valid_now & slot_active
+
+            cache_r = KVCache(
+                k=jax.lax.dynamic_slice_in_dim(s.k, row0, Bs, axis=1),
+                v=jax.lax.dynamic_slice_in_dim(s.v, row0, Bs, axis=1),
+                pos=jax.lax.dynamic_slice_in_dim(s.kpos, row0, Bs, axis=0),
+                length=off_r,
+            )
+            h_new, cache_r_new = fns.stage(
+                cfg, layers, h_in, cache_r, pos_rows[:, None], lmask
+            )
+            # Unconditional commit: a garbage write lands at an offset the
+            # next real serve overwrites (offsets only advance on `advance`).
+            def upd(big, small, axis):
+                return jax.lax.dynamic_update_slice_in_dim(
+                    big, small, row0, axis=axis
+                )
+
+            k_st = upd(s.k, cache_r_new.k, 1)
+            v_st = upd(s.v, cache_r_new.v, 1)
+            kpos_st = upd(s.kpos, cache_r_new.pos, 0)
+            write_off = jnp.where(
+                advance, s.write_off.at[r].add(1), s.write_off
+            )
+            pos_slots = jnp.where(
+                advance, s.pos_slots.at[served_rows].add(1), s.pos_slots
+            )
+
+            # ---- completion for the slot the LAST stage served ----
+            r_done = jnp.mod(m - last, num_stages)
+            rowd = r_done * Bs
+            done_rows = jax.lax.dynamic_slice_in_dim(s.done, rowd, Bs)
+            row_ids = rowd + jnp.arange(Bs, dtype=jnp.int32)
+
+            h_done = psum_from(h_new[:, 0], last)  # [Bs, H]
+            valid_done = (
+                psum_from(valid_now.astype(jnp.int32), last) > 0
+            )
+            nxt = sp_next_token(cfg, hd, h_done)
+            nxt = jnp.where(done_rows, 0, nxt)
+
+            len_rows = jax.lax.dynamic_slice_in_dim(s.lengths, rowd, Bs)
+            bud_rows = jax.lax.dynamic_slice_in_dim(s.budget, rowd, Bs)
+            commit = valid_done & ~done_rows & (len_rows < bud_rows)
+            wpos = len_rows
+            cur = s.out[row_ids, wpos]
+            out = s.out.at[row_ids, wpos].set(jnp.where(commit, nxt, cur))
+            lengths = s.lengths.at[row_ids].add(commit.astype(jnp.int32))
+            new_len = len_rows + commit.astype(jnp.int32)
+            done = s.done.at[row_ids].set(
+                done_rows
+                | (commit & (_is_stop(cfg, nxt) | (new_len >= bud_rows)))
+            )
+
+            # re-embed fresh tokens; last stage sends them around the ring
+            h_embed = sp_embed(cfg, hd, nxt[:, None], wpos[:, None])
+            h_send = jnp.where(sidx == last, h_embed.astype(s.h.dtype), h_new)
+            h_out = jax.lax.ppermute(h_send, PIPE_AXIS, ring)
+            # Validity gating uses POST-update done state: the sent block
+            # belongs to this device's served slot r (on the last stage
+            # r == r_done), and a block whose slot just finished (or was
+            # already finished) is dead and must travel invalid — otherwise a
+            # slot re-admitted at a chunk boundary within one ring cycle of
+            # finishing would decode from the previous request's leftover
+            # block.
+            done_sent = jax.lax.dynamic_slice_in_dim(done, row0, Bs)
+            sent_valid = valid_now & ~jnp.all(done_sent)
+            h_valid_out = (
+                jax.lax.ppermute(
+                    sent_valid.astype(jnp.int32), PIPE_AXIS, ring
+                )
+                > 0
+            )
+
+            # stage 0 consumed its slot's injection this microstep — clear it
+            # (identical computation on every device: stage 0's slot is m mod S)
+            clear0 = jnp.mod(m, num_stages) * Bs + jnp.arange(
+                Bs, dtype=jnp.int32
+            )
+            inject_pending = s.inject_pending.at[clear0].set(False)
+
+            return s._replace(
+                k=k_st, v=v_st, kpos=kpos_st, h=h_out, h_valid=h_valid_out,
+                pos_slots=pos_slots, write_off=write_off, out=out,
+                lengths=lengths, done=done, inject_pending=inject_pending,
+                m=m + 1,
+            )
+
+        st = jax.lax.fori_loop(0, n_micro, micro, st)
+        return jax.tree.map(
+            lambda spec, leaf: leaf[None] if spec == P(PIPE_AXIS) else leaf,
+            state_specs(state), st,
+        )
+
+    specs = state_specs(ServeState(*([None] * len(ServeState._fields))))
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(PIPE_AXIS), P(PIPE_AXIS), head_specs(head_params), specs),
+        out_specs=specs,
+        check_vma=False,
+    )(stage_layers, layer_masks, head_params, state)

@@ -245,15 +245,19 @@ def convert_hf_checkpoint(
     st_files = sorted(
         f for f in os.listdir(model_dir) if f.endswith(".safetensors")
     )
+    handles: list[Any] = []
     if st_files:
         from safetensors import safe_open
 
         # name → open handle; safe_open.get_tensor reads ONE tensor at a time,
         # which is what keeps conversion memory at ~one-layer scale (the
-        # streaming contract in the module docstring).
+        # streaming contract in the module docstring). Handles are tracked and
+        # closed in the finally below — one leaked fd per shard file adds up on
+        # large multi-shard checkpoints.
         index: dict[str, Any] = {}
         for fn in st_files:
             handle = safe_open(os.path.join(model_dir, fn), framework="numpy")
+            handles.append(handle)
             for name in handle.keys():
                 index[name] = handle
 
@@ -278,5 +282,11 @@ def convert_hf_checkpoint(
         def get(name: str) -> np.ndarray:
             return sd[name]
 
-    save_shards_streaming(cfg, get, out_dir, dtype, tokenizer_dir=model_dir)
+    try:
+        save_shards_streaming(cfg, get, out_dir, dtype, tokenizer_dir=model_dir)
+    finally:
+        for h in handles:
+            close = getattr(h, "close", None)
+            if close is not None:
+                close()
     return cfg

@@ -1,0 +1,68 @@
+"""Vocab-sharded head: memory accounting + host-side shard layout.
+
+VERDICT r1 #3: the round-1 engine replicated embed + lm_head on every chip
+(~2.1 GB extra per stage for untied Llama-3-8B). These tests pin the fix: the
+per-stage head footprint must drop by ≥1.5 GB for an 8-way llama3-8b
+placement, and the stacked shards must reassemble to the full tables.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from llm_sharding_tpu.models import llama
+from llm_sharding_tpu.models.config import llama3_8b, tiny_llama
+from llm_sharding_tpu.parallel.head import (
+    head_bytes_per_stage,
+    head_bytes_replicated,
+    shard_head_host,
+    vocab_shard_size,
+)
+
+
+def test_llama3_8b_head_memory_drop():
+    """8-way vocab sharding must reclaim ≥1.5 GB per chip vs replication
+    (embed 128256×4096 bf16 ≈ 1.05 GB + untied lm_head ≈ 1.05 GB →
+    ~0.26 GB sharded)."""
+    cfg = llama3_8b()
+    assert not cfg.tie_word_embeddings
+    drop = head_bytes_replicated(cfg) - head_bytes_per_stage(cfg, 8)
+    assert drop >= 1.5 * 2**30, f"only reclaimed {drop / 2**30:.2f} GB"
+
+
+def test_shard_roundtrip_untied():
+    """Stacked shards reassemble exactly to the original tables (including
+    vocab padding handling for V not divisible by num_stages)."""
+    cfg = tiny_llama(vocab_size=250)  # 250 % 4 != 0 → padded shards
+    S = 4
+    rng = np.random.default_rng(0)
+    head = {
+        "embed": rng.normal(size=(250, cfg.hidden_size)).astype(np.float32),
+        "final_norm": np.ones((cfg.hidden_size,), np.float32),
+        "lm_head": rng.normal(size=(cfg.hidden_size, 250)).astype(np.float32),
+    }
+    sharded = shard_head_host(cfg, head, S)
+    Vs = vocab_shard_size(250, S)
+    assert sharded["embed"].shape == (S, Vs, cfg.hidden_size)
+    assert sharded["lm_head"].shape == (S, cfg.hidden_size, Vs)
+    np.testing.assert_array_equal(
+        sharded["embed"].reshape(S * Vs, -1)[:250], head["embed"]
+    )
+    reasm = np.concatenate(list(sharded["lm_head"]), axis=1)[:, :250]
+    np.testing.assert_array_equal(reasm, head["lm_head"])
+    np.testing.assert_array_equal(sharded["final_norm"], head["final_norm"])
+
+
+def test_device_head_arrays_are_sharded():
+    """After apply_placement, embed/lm_head device arrays must be sharded
+    over the pipe axis (addressable shard = 1/num_stages of the table), not
+    replicated."""
+    from llm_sharding_tpu.runtime.engine import PipelineEngine
+
+    cfg = tiny_llama(num_hidden_layers=8)
+    params = llama.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    eng = PipelineEngine(cfg, params, num_stages=4)
+    emb = eng.head_params["embed"]
+    assert emb.shape[0] == 4
+    shard = emb.addressable_shards[0]
+    assert shard.data.shape[0] == 1  # one stage slice per device

@@ -78,7 +78,28 @@ def test_ragged_prompts_mixed_lengths(setup):
 
 
 def test_too_many_requests_rejected(setup):
+    """With an explicit batch_per_slot, rows are bounded; without one, it
+    auto-scales (see test_batch_per_slot)."""
     _, mesh, sl, masks, head = setup
     prompts = np.ones((5, 3), np.int32)
-    with pytest.raises(ValueError, match="slots"):
-        interleaved_generate(CFG, mesh, sl, masks, head, prompts, 4)
+    with pytest.raises(ValueError, match="rows"):
+        interleaved_generate(
+            CFG, mesh, sl, masks, head, prompts, 4, batch_per_slot=1
+        )
+
+
+def test_batch_per_slot(setup):
+    """More requests than stages: slots carry batched rows, each request
+    still token-exact vs its solo decode."""
+    params, mesh, sl, masks, head = setup
+    rng = np.random.default_rng(7)
+    prompts = rng.integers(1, CFG.vocab_size, (7, 4)).astype(np.int32)
+    N = 6
+    res = interleaved_generate(
+        CFG, mesh, sl, masks, head, prompts, N, cache_dtype=jnp.float32
+    )
+    assert res.tokens.shape[0] == 7
+    for r in range(7):
+        oracle = generate(CFG, params, prompts[r], N, cache_dtype=jnp.float32)
+        np.testing.assert_array_equal(res.tokens[r], oracle.tokens[0])
+        assert res.lengths[r] == oracle.lengths[0]
