@@ -1,0 +1,268 @@
+"""Operator CLI: ``python -m llm_sharding_tpu <command>``.
+
+The reference is driven from a shell — per-node daemons (``start_node.py:
+6-20``), a config pusher (``send_config.py:5-48``), profiler entries
+(``profiling.py:1-19``), a monolithic baseline (``inference.py:36-49``) and a
+pod launcher (``run_this.sh:8-17``). One host owning the whole mesh collapses
+those five entry points into subcommands:
+
+- ``convert``  — HF checkpoint → shard store (≙ running ``model_sharder.py``)
+- ``generate`` — one prompt through the sharded pipeline (≙ ``inference.py``,
+  but pipelined; ``--stream`` streams tokens from the sharded program)
+- ``serve``    — persistent interactive daemon over stdin (≙ ``start_node.py``
+  + ``run_worker_loop``), continuous batching underneath
+- ``profile``  — capability sweeps, hop latency, artifacts + an optional
+  capability-weighted placement suggestion (≙ ``profiling.py``; closes the
+  profiler→scheduler loop of the reference's README)
+- ``bench``    — the repo benchmark (one JSON line)
+
+Placements: ``--stages N`` for a balanced split or ``--ranges 0:6,6:7,7:32``
+for the reference-style ragged chains (``send_config.py:10-34``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+import numpy as np
+
+
+def _dtype(name: str):
+    import jax.numpy as jnp
+
+    return {
+        "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+        "f32": jnp.float32, "float32": jnp.float32,
+        "f16": jnp.float16, "float16": jnp.float16,
+    }[name]
+
+
+def _parse_ranges(text: str):
+    ranges = []
+    for part in text.split(","):
+        a, b = part.split(":")
+        ranges.append((int(a), int(b)))
+    return ranges
+
+
+def _placement(args, num_layers: int):
+    from .parallel.placement import PlacementSpec
+
+    if getattr(args, "ranges", None):
+        return PlacementSpec.from_ranges(_parse_ranges(args.ranges), num_layers)
+    if getattr(args, "stages", None):
+        return PlacementSpec.balanced(num_layers, args.stages)
+    return None
+
+
+def _engine(args):
+    from .runtime.engine import PipelineEngine
+    from .utils import shard_store
+
+    cfg = shard_store.load_config(args.shards)
+    placement = _placement(args, cfg.num_hidden_layers)
+    return PipelineEngine.from_shards(
+        args.shards,
+        placement=placement,
+        num_stages=None if placement else getattr(args, "stages", None),
+        dtype=_dtype(args.dtype),
+    )
+
+
+def cmd_convert(args) -> int:
+    from .utils.shard_store import convert_hf_checkpoint
+
+    cfg = convert_hf_checkpoint(args.model_dir, args.out_dir, _dtype(args.dtype))
+    print(
+        f"converted {cfg.model_type} ({cfg.num_hidden_layers} layers, "
+        f"vocab {cfg.vocab_size}) -> {args.out_dir}"
+    )
+    return 0
+
+
+def cmd_generate(args) -> int:
+    eng = _engine(args)
+    if args.stream:
+        for delta in eng.generate_text_stream(args.prompt, args.max_new):
+            print(delta, end="", flush=True)
+        print()
+    else:
+        print(eng.generate_text(args.prompt, args.max_new))
+    return 0
+
+
+def cmd_serve(args) -> int:
+    """Interactive persistent daemon: one prompt per stdin line, streamed
+    completion per line (≙ the reference's forever-spinning worker loop)."""
+    eng = _engine(args)
+    srv = eng.serve(
+        capacity=args.capacity, batch_per_slot=args.batch_per_slot
+    )
+    print(
+        f"serving {eng.cfg.model_type} over {eng.mesh.shape} "
+        f"(capacity={args.capacity}); enter a prompt, ^D to exit",
+        file=sys.stderr,
+    )
+    tok = eng._require_tokenizer()
+    for line in sys.stdin:
+        prompt = line.rstrip("\n")
+        if not prompt:
+            continue
+        ids = np.asarray(tok(prompt)["input_ids"], np.int32)
+        req = srv.submit(ids, args.max_new)
+        acc: list[int] = []
+        prev = ""
+        for t in srv.stream(req):
+            acc.append(t)
+            text = tok.decode(acc, skip_special_tokens=True)
+            if len(text) > len(prev) and not text.endswith("�"):
+                print(text[len(prev):], end="", flush=True)
+                prev = text
+        print(flush=True)
+    print(json.dumps(srv.counters.snapshot()), file=sys.stderr)
+    return 0
+
+
+def cmd_profile(args) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from .models import llama as llama_mod
+    from .profiler.artifacts import save_profile_artifacts
+    from .profiler.profiler import (
+        Profiler, max_layers_fit, measure_hop_latency, profile_cold_start,
+    )
+
+    dtype = _dtype(args.dtype)
+    cold = None
+    if args.shards:
+        from .utils import shard_store
+
+        cfg, params = shard_store.load_full(args.shards, dtype=dtype)
+        if args.cold_start:
+            cold = profile_cold_start(args.shards, dtype=dtype)
+    else:
+        from .models import config as config_mod
+
+        cfg = getattr(config_mod, args.preset)()
+        params = llama_mod.init_params(cfg, jax.random.key(0), dtype=dtype)
+
+    prof = Profiler(cfg, params, dtype=dtype)
+    prefill = prof.profile_prefill()
+    decode = prof.profile_decode(max_tokens=args.decode_tokens)
+    verdict = Profiler.similarity_verdict(prefill, decode)
+
+    hop = None
+    if args.hops:
+        from .parallel.mesh import pipeline_mesh
+
+        n = min(args.hops, len(jax.devices()))
+        hop = measure_hop_latency(
+            pipeline_mesh(n), hidden_size=cfg.hidden_size, dtype=dtype
+        )
+
+    extra = {
+        "config": json.loads(cfg.to_json()),
+        "max_layers_fit": max_layers_fit(cfg, param_dtype=dtype),
+    }
+    if args.suggest_stages:
+        from .parallel.placement import PlacementSpec
+
+        # homogeneous chips: per-stage capability = 1/c_k each; shown so the
+        # operator sees the profiler→placement loop end to end
+        spec = PlacementSpec.from_capabilities(
+            cfg.num_hidden_layers, [1.0 / prefill.capability_c_k] * args.suggest_stages
+        )
+        extra["suggested_placement"] = list(spec.stages)
+
+    payload = save_profile_artifacts(
+        args.out, prefill=prefill, decode=decode, verdict=verdict,
+        cold_start=cold, hop=hop, extra=extra,
+    )
+    print(json.dumps(payload, indent=2))
+    print(f"artifacts -> {args.out}", file=sys.stderr)
+    return 0
+
+
+def cmd_bench(args) -> int:
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(__file__)), "bench.py")
+    spec = importlib.util.spec_from_file_location("bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main()
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m llm_sharding_tpu",
+        description="TPU-native model-chain framework — operator commands",
+    )
+    p.add_argument("-v", "--verbose", action="store_true")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    c = sub.add_parser("convert", help="HF checkpoint dir -> shard store")
+    c.add_argument("model_dir")
+    c.add_argument("out_dir")
+    c.add_argument("--dtype", default="bf16")
+    c.set_defaults(fn=cmd_convert)
+
+    g = sub.add_parser("generate", help="run one prompt through the pipeline")
+    g.add_argument("shards")
+    g.add_argument("--prompt", required=True)
+    g.add_argument("--max-new", type=int, default=128, dest="max_new")
+    g.add_argument("--stages", type=int)
+    g.add_argument("--ranges", help="ragged layer ranges, e.g. 0:6,6:7,7:32")
+    g.add_argument("--dtype", default="bf16")
+    g.add_argument("--stream", action="store_true")
+    g.set_defaults(fn=cmd_generate)
+
+    s = sub.add_parser("serve", help="persistent stdin daemon (streaming)")
+    s.add_argument("shards")
+    s.add_argument("--max-new", type=int, default=256, dest="max_new")
+    s.add_argument("--stages", type=int)
+    s.add_argument("--ranges")
+    s.add_argument("--capacity", type=int, default=1024)
+    s.add_argument("--batch-per-slot", type=int, default=1, dest="batch_per_slot")
+    s.add_argument("--dtype", default="bf16")
+    s.set_defaults(fn=cmd_serve)
+
+    pr = sub.add_parser("profile", help="capability sweeps + artifacts")
+    src = pr.add_mutually_exclusive_group(required=True)
+    src.add_argument("--shards")
+    src.add_argument(
+        "--preset",
+        help="config preset name (random weights), e.g. tiny_llama, llama32_3b",
+    )
+    pr.add_argument("--out", default="results/profiling")
+    pr.add_argument("--dtype", default="bf16")
+    pr.add_argument("--decode-tokens", type=int, default=64, dest="decode_tokens")
+    pr.add_argument(
+        "--hops", type=int, default=0,
+        help="measure per-hop ppermute latency over an N-stage mesh",
+    )
+    pr.add_argument("--cold-start", action="store_true", dest="cold_start")
+    pr.add_argument(
+        "--suggest-stages", type=int, default=0, dest="suggest_stages",
+        help="emit a capability-weighted placement for N stages",
+    )
+    pr.set_defaults(fn=cmd_profile)
+
+    b = sub.add_parser("bench", help="repo benchmark (one JSON line)")
+    b.set_defaults(fn=cmd_bench)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    return args.fn(args)

@@ -77,7 +77,14 @@ def forward_layers(
     cache: KVCache,
     positions: jnp.ndarray,
     layer_mask: Optional[jnp.ndarray] = None,
+    tp_axis: Optional[str] = None,
 ) -> tuple[jnp.ndarray, KVCache]:
+    if tp_axis is not None:
+        raise NotImplementedError(
+            "explicit TP inside gpt2 stages (fused qkv) is not implemented; "
+            "llama only"
+        )
+
     def apply(p, h, k_row, v_row, kv_pos, length):
         return decoder_layer(cfg, p, h, k_row, v_row, positions, kv_pos, length)
 

@@ -102,13 +102,23 @@ def attn_mlp_block(
     cos: jnp.ndarray,
     sin: jnp.ndarray,
     attn_fn,  # (q[B,S,Nh,D], k[B,S,Nkv,D], v[B,S,Nkv,D]) -> [B,S,Nh,D]
+    tp_axis: Optional[str] = None,
 ) -> jnp.ndarray:
     """One llama block with the attention mechanism injected — the single
     implementation behind the cached (pipeline/decode) path and the
-    ring-attention (context-parallel) path."""
+    ring-attention (context-parallel) path.
+
+    Head counts come from the WEIGHT shapes, not the config: under explicit
+    tensor parallelism (``tp_axis`` set, megatron layout — wq/wk/wv/w_gate/
+    w_up column-sharded, wo/w_down row-sharded) each device sees its local
+    head slice, and the two row-parallel matmuls are completed with a psum
+    over ``tp_axis``. With ``tp_axis=None`` and full weights this reduces to
+    the plain single-device block.
+    """
     B, S, H = h.shape
     D = cfg.head_dim_
-    Nh, Nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+    Nh = p["wq"].shape[-1] // D  # local (possibly TP-sharded) head counts
+    Nkv = p["wk"].shape[-1] // D
 
     x = rms_norm(h, p["input_norm"], cfg.rms_norm_eps)
     q = apply_rope((x @ p["wq"]).reshape(B, S, Nh, D), cos, sin)
@@ -116,11 +126,16 @@ def attn_mlp_block(
     v = (x @ p["wv"]).reshape(B, S, Nkv, D)
 
     attn = attn_fn(q, k, v)
-    h = h + attn.reshape(B, S, Nh * D) @ p["wo"]
+    attn_out = attn.reshape(B, S, Nh * D) @ p["wo"]
+    if tp_axis is not None:
+        attn_out = jax.lax.psum(attn_out, tp_axis)
+    h = h + attn_out
 
     x = rms_norm(h, p["post_norm"], cfg.rms_norm_eps)
     mlp = (jax.nn.silu((x @ p["w_gate"]).astype(jnp.float32)).astype(x.dtype)
            * (x @ p["w_up"])) @ p["w_down"]
+    if tp_axis is not None:
+        mlp = jax.lax.psum(mlp, tp_axis)
     return h + mlp
 
 
@@ -135,6 +150,7 @@ def decoder_layer(
     positions: jnp.ndarray,  # [B, S] absolute query positions
     kv_positions: jnp.ndarray,  # [B, C] per-slot key positions (post-write)
     length: jnp.ndarray,  # scalar int32: shared write offset for this step
+    tp_axis: Optional[str] = None,
 ):
     rows = {}
 
@@ -148,7 +164,7 @@ def decoder_layer(
         rows["k"], rows["v"] = k_r, v_r
         return attention_prefill(q, k_r, v_r, positions, kv_positions)
 
-    h = attn_mlp_block(cfg, p, h, cos, sin, attn_fn)
+    h = attn_mlp_block(cfg, p, h, cos, sin, attn_fn, tp_axis)
     return h, rows["k"], rows["v"]
 
 
@@ -159,19 +175,22 @@ def forward_layers(
     cache: KVCache,
     positions: jnp.ndarray,
     layer_mask: Optional[jnp.ndarray] = None,  # [L] bool — False = pass-through
+    tp_axis: Optional[str] = None,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Run ``h`` through a stack of decoder layers via ``lax.scan``.
 
     ``layer_mask`` enables ragged pipeline stages: masked-out layers leave the
     hidden state and their cache rows untouched, so every stage can scan the
     same (padded) layer count in one SPMD program (SURVEY.md §7 "uneven layer
-    splits").
+    splits"). ``tp_axis`` turns on explicit megatron TP inside every layer
+    (weights and KV cache must carry the matching local head slices).
     """
     cos, sin = rope_cos_sin(positions, cfg, dtype=jnp.float32)
 
     def apply(p, h, k_row, v_row, kv_pos, length):
         return decoder_layer(
-            cfg, p, h, k_row, v_row, cos, sin, positions, kv_pos, length
+            cfg, p, h, k_row, v_row, cos, sin, positions, kv_pos, length,
+            tp_axis,
         )
 
     return scan_layers(layers, h, cache, positions, apply, layer_mask)

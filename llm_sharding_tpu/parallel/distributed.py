@@ -54,14 +54,9 @@ def hybrid_mesh(
     """N-D mesh over (data, pipe, seq, tensor), axis sizes multiplying to the
     device count used. Uses all global devices by default — correct for
     multi-host SPMD where every process sees the full device list."""
-    devices = list(devices if devices is not None else jax.devices())
-    need = data * pipe * seq * tensor
-    if len(devices) < need:
-        raise ValueError(
-            f"mesh {data}x{pipe}x{seq}x{tensor} needs {need} devices, "
-            f"have {len(devices)}"
-        )
-    arr = np.asarray(devices[:need]).reshape(data, pipe, seq, tensor)
+    from .mesh import _device_grid
+
+    arr = _device_grid((data, pipe, seq, tensor), devices)
     return Mesh(arr, (DATA_AXIS, PIPE_AXIS, SEQ_AXIS, TENSOR_AXIS))
 
 

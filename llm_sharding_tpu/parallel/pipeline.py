@@ -69,12 +69,47 @@ class ModelFns(NamedTuple):
     stage: Any  # (cfg, layers, h, cache, positions, mask) -> (h, cache)
 
 
-def model_fns(cfg: ModelConfig) -> ModelFns:
+def model_fns(cfg: ModelConfig, tp_axis: Optional[str] = None) -> ModelFns:
     if cfg.model_type == "llama":
-        return ModelFns(stage=llama.forward_layers)
+        fwd = llama.forward_layers
     elif cfg.model_type == "gpt2":
-        return ModelFns(stage=gpt2.forward_layers)
-    raise ValueError(f"unsupported model_type: {cfg.model_type!r}")
+        fwd = gpt2.forward_layers
+    else:
+        raise ValueError(f"unsupported model_type: {cfg.model_type!r}")
+
+    def stage(cfg_, layers, h, cache, positions, mask):
+        return fwd(cfg_, layers, h, cache, positions, mask, tp_axis=tp_axis)
+
+    return ModelFns(stage=stage)
+
+
+def mesh_axis_sizes(mesh: Mesh) -> tuple[int, int, int]:
+    """(data, pipe, tensor) axis sizes of a (possibly hybrid) mesh — absent
+    axes count as 1, so the 1-D pipe mesh is the degenerate case."""
+    from .tensor import TENSOR_AXIS
+    from .mesh import DATA_AXIS
+
+    shape = dict(mesh.shape)
+    return (
+        shape.get(DATA_AXIS, 1),
+        shape.get(PIPE_AXIS, 1),
+        shape.get(TENSOR_AXIS, 1),
+    )
+
+
+def stage_layer_specs(cfg: ModelConfig, tp: int):
+    """shard_map in_specs for the [num_stages, Lp, ...] stage arrays: pipe on
+    the leading axis; with tensor parallelism, megatron column/row sharding on
+    the weight dims (specs from ``tensor.llama_tp_specs`` shifted under the
+    two leading stack axes)."""
+    if tp == 1:
+        return P(PIPE_AXIS)  # pytree-prefix spec: applies to every leaf
+    if cfg.model_type != "llama":
+        raise NotImplementedError("pp×tp: llama only")
+    from .tensor import llama_tp_specs
+
+    per_leaf = llama_tp_specs(stacked=False)["layers"]
+    return {k: P(PIPE_AXIS, None, *s) for k, s in per_leaf.items()}
 
 
 def _tree_where(pred, new, old):
@@ -161,10 +196,17 @@ def _pipeline_generate_jit(
     capacity: int,
     cache_dtype,
 ):
-    fns = model_fns(cfg)
+    from .mesh import DATA_AXIS
+
+    from .tensor import TENSOR_AXIS
+
+    dp, _, tp = mesh_axis_sizes(mesh)
+    fns = model_fns(cfg, tp_axis=TENSOR_AXIS if tp > 1 else None)
     B, S = prompt.shape
+    Bl = B // dp  # rows per data replica
     total = S + max_new_tokens
     Lp = layer_masks.shape[1]
+    Nkv_local = cfg.num_key_value_heads // tp
     ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
 
     def body(stage_layers, layer_mask, head_params, prompt, prompt_len):
@@ -176,14 +218,14 @@ def _pipeline_generate_jit(
 
         cache = KVCache(
             k=jnp.zeros(
-                (Lp, B, capacity, cfg.num_key_value_heads, cfg.head_dim_),
+                (Lp, Bl, capacity, Nkv_local, cfg.head_dim_),
                 cache_dtype,
             ),
             v=jnp.zeros(
-                (Lp, B, capacity, cfg.num_key_value_heads, cfg.head_dim_),
+                (Lp, Bl, capacity, Nkv_local, cfg.head_dim_),
                 cache_dtype,
             ),
-            pos=jnp.full((B, capacity), POS_SENTINEL, jnp.int32),
+            pos=jnp.full((Bl, capacity), POS_SENTINEL, jnp.int32),
             length=jnp.zeros((), jnp.int32),
         )
 
@@ -209,9 +251,9 @@ def _pipeline_generate_jit(
         h_last = psum_from(h_last, 0)
         tok = sp_next_token(cfg, hd, h_last)  # [B], replicated
 
-        out = jnp.zeros((B, total), jnp.int32)
+        out = jnp.zeros((Bl, total), jnp.int32)
         out = jax.lax.dynamic_update_slice(out, prompt, (0, 0))
-        out = out.at[jnp.arange(B), prompt_len].set(tok)
+        out = out.at[jnp.arange(Bl), prompt_len].set(tok)
         done = _is_stop(cfg, tok)
         lengths = prompt_len + 1
 
@@ -235,7 +277,7 @@ def _pipeline_generate_jit(
             nxt = sp_next_token(cfg, hd, h_last)
             nxt = jnp.where(s["done"], 0, nxt)
             new_pos = s["pos"] + 1
-            out = s["out"].at[jnp.arange(B), new_pos].set(nxt)
+            out = s["out"].at[jnp.arange(Bl), new_pos].set(nxt)
             out = jnp.where(s["done"][:, None], s["out"], out)
             done = s["done"] | _is_stop(cfg, nxt)
             return dict(
@@ -251,17 +293,18 @@ def _pipeline_generate_jit(
         state = jax.lax.while_loop(cond, step, state)
         return state["out"], state["lengths"]
 
+    batch_spec = P(DATA_AXIS) if dp > 1 else P()
     out, lengths = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(
-            P(PIPE_AXIS),
+            stage_layer_specs(cfg, tp),
             P(PIPE_AXIS),
             head_specs(head_params),
-            P(),
-            P(),
+            batch_spec,
+            batch_spec,
         ),
-        out_specs=(P(), P()),
+        out_specs=(batch_spec, batch_spec),
         check_vma=False,
     )(stage_layers, layer_masks, head_params, prompt, prompt_len)
     return out, lengths
@@ -294,6 +337,14 @@ def pipeline_generate(
     num_stages = mesh.shape[PIPE_AXIS]
     check_stage_shapes(layer_masks, num_stages)
     head_params = ensure_sharded_head(cfg, head_params, num_stages)
+
+    dp, _, tp = mesh_axis_sizes(mesh)
+    if tp > 1:
+        from .tensor import validate_tp
+
+        validate_tp(cfg, tp)
+    if B % dp != 0:
+        raise ValueError(f"batch {B} not divisible by data-parallel size {dp}")
 
     out, lengths = _pipeline_generate_jit(
         cfg,

@@ -97,6 +97,52 @@ class PlacementSpec:
     ) -> "PlacementSpec":
         return cls(tuple(ranges), num_layers)
 
+    @classmethod
+    def from_capabilities(
+        cls, num_layers: int, capabilities: Sequence[float]
+    ) -> "PlacementSpec":
+        """Capability-weighted ragged split — the scheduler the reference's
+        profiler exists to feed (``/root/reference/README.md:8``: measured
+        per-device capabilities → layer allocation).
+
+        ``capabilities[i]`` is a throughput proxy for stage i — higher =
+        faster; use ``1 / c_k`` from ``profiler.PrefillReport.capability_c_k``
+        or ``1 / stage_time`` from ``Profiler.profile_stage``. Layers are
+        allocated proportionally (contiguous, ≥1 per stage) so per-stage time
+        ``layers_i / capabilities_i`` is balanced.
+        """
+        caps = np.asarray(capabilities, np.float64)
+        if caps.ndim != 1 or len(caps) < 1:
+            raise ValueError("capabilities must be a 1-D sequence")
+        if np.any(caps <= 0):
+            raise ValueError(f"capabilities must be positive, got {caps}")
+        S = len(caps)
+        if S > num_layers:
+            raise ValueError(f"{S} stages > {num_layers} layers")
+        raw = caps / caps.sum() * num_layers
+        counts = np.maximum(1, np.round(raw).astype(int))
+        # repair rounding drift toward the proportional target, keeping ≥1
+        while counts.sum() > num_layers:
+            over = counts - raw  # most over-allocated stage gives one back
+            over[counts <= 1] = -np.inf
+            counts[int(np.argmax(over))] -= 1
+        while counts.sum() < num_layers:
+            counts[int(np.argmin(counts - raw))] += 1
+        stages, cursor = [], 0
+        for n in counts:
+            stages.append((cursor, cursor + int(n)))
+            cursor += int(n)
+        return cls(tuple(stages), num_layers)
+
+    @classmethod
+    def from_stage_times(
+        cls, num_layers: int, stage_times: Sequence[float]
+    ) -> "PlacementSpec":
+        """Split from measured per-stage (equal-layer) times: a stage that
+        measured 2× slower gets ~half the layers."""
+        t = np.asarray(stage_times, np.float64)
+        return cls.from_capabilities(num_layers, 1.0 / t)
+
 
 def stack_stage_params(
     spec: PlacementSpec, full_layers: dict[str, Any]

@@ -267,7 +267,7 @@ class PipelineServer:
         for row, req in enumerate(self._rows):
             if req is None or req.done:
                 continue
-            seen = self._lengths_seen[row]
+            seen = int(self._lengths_seen[row])
             # first fetch for this row starts after the prompt
             lo = max(seen, req.prompt_len)
             hi = int(lengths[row])

@@ -1,0 +1,127 @@
+"""Operator CLI: a shell user can generate, serve, and profile without
+writing Python (VERDICT r1 missing #1 / next-round #4; ≙ the reference's
+entry scripts ``start_node.py`` / ``send_config.py`` / ``profiling.py`` /
+``inference.py``)."""
+
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from llm_sharding_tpu import cli
+from llm_sharding_tpu.models import llama
+from llm_sharding_tpu.models.config import tiny_llama
+from llm_sharding_tpu.utils import shard_store
+
+CFG = tiny_llama(num_hidden_layers=8, vocab_size=64)
+
+
+class IdTokenizer:
+    """Minimal tokenizer standing in for HF AutoTokenizer in CLI tests."""
+
+    def __call__(self, text):
+        return {"input_ids": [ord(c) % 60 + 1 for c in text]}
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(chr(int(i) % 26 + 97) for i in ids)
+
+
+@pytest.fixture(scope="module")
+def shards(tmp_path_factory):
+    params = llama.init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+    out = str(tmp_path_factory.mktemp("cli") / "tiny_f32")
+    shard_store.save_shards(CFG, params, out)
+    return out
+
+
+def test_generate_command(shards, capsys, monkeypatch):
+    from llm_sharding_tpu.runtime import engine as engine_mod
+
+    monkeypatch.setattr(
+        engine_mod.PipelineEngine,
+        "_require_tokenizer",
+        lambda self: IdTokenizer(),
+    )
+    rc = cli.main(
+        [
+            "generate", shards, "--prompt", "hello", "--max-new", "6",
+            "--stages", "4", "--dtype", "f32",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out.strip()
+    assert len(out) > 0
+
+
+def test_generate_ragged_ranges_stream(shards, capsys, monkeypatch):
+    from llm_sharding_tpu.runtime import engine as engine_mod
+
+    monkeypatch.setattr(
+        engine_mod.PipelineEngine,
+        "_require_tokenizer",
+        lambda self: IdTokenizer(),
+    )
+    rc = cli.main(
+        [
+            "generate", shards, "--prompt", "abc", "--max-new", "5",
+            "--ranges", "0:5,5:6,6:8", "--dtype", "f32", "--stream",
+        ]
+    )
+    assert rc == 0
+    assert len(capsys.readouterr().out.strip()) > 0
+
+
+def test_serve_command_stdin(shards, capsys, monkeypatch):
+    from llm_sharding_tpu.runtime import engine as engine_mod
+
+    monkeypatch.setattr(
+        engine_mod.PipelineEngine,
+        "_require_tokenizer",
+        lambda self: IdTokenizer(),
+    )
+    monkeypatch.setattr("sys.stdin", io.StringIO("hi there\nsecond prompt\n"))
+    rc = cli.main(
+        [
+            "serve", shards, "--max-new", "4", "--stages", "4",
+            "--capacity", "64", "--dtype", "f32",
+        ]
+    )
+    assert rc == 0
+    captured = capsys.readouterr()
+    # two prompts -> two completion lines on stdout, counters on stderr
+    assert len([l for l in captured.out.splitlines() if l.strip()]) == 2
+    assert '"requests_completed": 2' in captured.err
+
+
+def test_profile_command_artifacts(tmp_path, capsys):
+    out_dir = str(tmp_path / "prof")
+    rc = cli.main(
+        [
+            "profile", "--preset", "tiny_llama", "--out", out_dir,
+            "--dtype", "f32", "--decode-tokens", "8", "--hops", "4",
+            "--suggest-stages", "4",
+        ]
+    )
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["prefill"]["capability_c_k"] > 0
+    assert payload["decode"]["capability_c_k"] > 0
+    assert payload["hop_latency"]["p50_us"] > 0
+    assert len(payload["suggested_placement"]) == 4
+    assert os.path.exists(os.path.join(out_dir, "profile.json"))
+    assert os.path.exists(os.path.join(out_dir, "prefill_fit.png"))
+    assert os.path.exists(os.path.join(out_dir, "decode_fit.png"))
+
+
+def test_convert_requires_weights(tmp_path):
+    src = tmp_path / "empty_model"
+    src.mkdir()
+    (src / "config.json").write_text(
+        json.dumps({"model_type": "gpt2", "n_layer": 1})
+    )
+    with pytest.raises(FileNotFoundError):
+        cli.main(["convert", str(src), str(tmp_path / "out")])
