@@ -130,10 +130,10 @@ def cmd_profile(args) -> int:
     import jax
     import jax.numpy as jnp
 
-    from .models import llama as llama_mod
     from .profiler.artifacts import save_profile_artifacts
     from .profiler.profiler import (
-        Profiler, max_layers_fit, measure_hop_latency, profile_cold_start,
+        Profiler, detect_hbm_bytes, max_layers_fit, measure_hop_latency,
+        profile_cold_start,
     )
 
     dtype = _dtype(args.dtype)
@@ -148,7 +148,16 @@ def cmd_profile(args) -> int:
         from .models import config as config_mod
 
         cfg = getattr(config_mod, args.preset)()
-        params = llama_mod.init_params(cfg, jax.random.key(0), dtype=dtype)
+        if cfg.model_type == "llama":
+            from .models import llama as model_mod
+        elif cfg.model_type == "gpt2":
+            from .models import gpt2 as model_mod
+        else:
+            raise SystemExit(
+                f"preset {args.preset!r} has unsupported model_type "
+                f"{cfg.model_type!r} for random-weight profiling"
+            )
+        params = model_mod.init_params(cfg, jax.random.key(0), dtype=dtype)
 
     prof = Profiler(cfg, params, dtype=dtype)
     prefill = prof.profile_prefill()
@@ -164,10 +173,16 @@ def cmd_profile(args) -> int:
             pipeline_mesh(n), hidden_size=cfg.hidden_size, dtype=dtype
         )
 
-    extra = {
-        "config": json.loads(cfg.to_json()),
-        "max_layers_fit": max_layers_fit(cfg, param_dtype=dtype),
-    }
+    extra = {"config": json.loads(cfg.to_json())}
+    # Memory fit is only reportable when device memory is determinable: an
+    # explicit --hbm-gib, runtime memory_stats, or a known TPU kind. On CPU
+    # hosts (like the reference profiler running wherever it's pointed,
+    # node_profiler.py:300-308) the field is omitted rather than guessed.
+    hbm = int(args.hbm_gib * 1024**3) if args.hbm_gib else detect_hbm_bytes()
+    if hbm is not None:
+        extra["max_layers_fit"] = max_layers_fit(
+            cfg, param_dtype=dtype, hbm_bytes=hbm
+        )
     if args.suggest_stages:
         from .parallel.placement import PlacementSpec
 
@@ -248,6 +263,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="measure per-hop ppermute latency over an N-stage mesh",
     )
     pr.add_argument("--cold-start", action="store_true", dest="cold_start")
+    pr.add_argument(
+        "--hbm-gib", type=float, default=0.0, dest="hbm_gib",
+        help="device memory to assume for max_layers_fit (auto-detected on "
+        "TPU; omitted from the report when undeterminable)",
+    )
     pr.add_argument(
         "--suggest-stages", type=int, default=0, dest="suggest_stages",
         help="emit a capability-weighted placement for N stages",

@@ -31,6 +31,44 @@ from .stack import scan_layers
 Params = dict[str, Any]
 
 
+def init_layer_params(
+    cfg: ModelConfig, key: jax.Array, num_layers: int, dtype=jnp.bfloat16
+) -> Params:
+    H, I = cfg.hidden_size, cfg.intermediate_size
+    ks = jax.random.split(key, 4)
+    L = num_layers
+
+    def w(k, *shape):
+        fan_in = shape[-2]
+        return (jax.random.normal(k, (L, *shape), jnp.float32) * fan_in**-0.5).astype(
+            dtype
+        )
+
+    return {
+        "ln1_w": jnp.ones((L, H), dtype), "ln1_b": jnp.zeros((L, H), dtype),
+        "w_qkv": w(ks[0], H, 3 * H), "b_qkv": jnp.zeros((L, 3 * H), dtype),
+        "w_proj": w(ks[1], H, H), "b_proj": jnp.zeros((L, H), dtype),
+        "ln2_w": jnp.ones((L, H), dtype), "ln2_b": jnp.zeros((L, H), dtype),
+        "w_fc": w(ks[2], H, I), "b_fc": jnp.zeros((L, I), dtype),
+        "w_out": w(ks[3], I, H), "b_out": jnp.zeros((L, H), dtype),
+    }
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    """Random weights with the converter's pytree layout (wte-tied head, so no
+    ``lm_head`` leaf) — for tests/profiling, like ``models/llama.init_params``."""
+    k_emb, k_pos, k_layers = jax.random.split(key, 3)
+    V, H = cfg.vocab_size, cfg.hidden_size
+    P = cfg.max_position_embeddings
+    return {
+        "embed": (jax.random.normal(k_emb, (V, H), jnp.float32) * H**-0.5).astype(dtype),
+        "pos_embed": (jax.random.normal(k_pos, (P, H), jnp.float32) * 0.02).astype(dtype),
+        "layers": init_layer_params(cfg, k_layers, cfg.num_hidden_layers, dtype),
+        "final_norm": jnp.ones((H,), dtype),
+        "final_norm_bias": jnp.zeros((H,), dtype),
+    }
+
+
 def embed(params: Params, token_ids: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
     """wte[ids] + wpe[positions] (≙ the reference's bundled GPT embedding,
     ``/root/reference/utils/model_sharder.py:100-108``)."""

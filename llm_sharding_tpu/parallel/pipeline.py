@@ -168,6 +168,14 @@ def ensure_sharded_head(cfg: ModelConfig, head_params, num_stages: int):
     or one already stacked by ``shard_head_host``. Hot paths (the engine)
     pre-shard once per placement; tests/dryruns may pass the full head."""
     if is_sharded_head(head_params):
+        got = head_params["embed"].shape[0]
+        if got != num_stages:
+            # a head pre-stacked for S stages silently mis-slices vocab on a
+            # mesh whose pipe size divides S — garbage tokens, no error
+            raise ValueError(
+                f"head was vocab-sharded for {got} stages but the mesh has "
+                f"{num_stages}; re-shard with shard_head_host"
+            )
         return head_params
     return shard_head_host(cfg, head_params, num_stages)
 

@@ -258,10 +258,19 @@ class PipelineEngine:
     def _shared_server(self, prompt_len: int, max_new: int):
         from .server import ADMIT_BUCKETS
 
+        if prompt_len > ADMIT_BUCKETS[-1]:
+            raise ValueError(
+                f"prompt length {prompt_len} exceeds the largest admission "
+                f"bucket ({ADMIT_BUCKETS[-1]})"
+            )
         bucket = next(b for b in ADMIT_BUCKETS if b >= prompt_len)
         needed = bucket + max_new
         srv = getattr(self, "_server", None)
         if srv is None or srv.capacity < needed:
+            if srv is not None:
+                # let streams on the old server finish before replacing it —
+                # swapping immediately would orphan their in-flight requests
+                srv.run_until_idle()
             cap = 64
             while cap < needed:
                 cap *= 2

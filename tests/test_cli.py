@@ -117,6 +117,60 @@ def test_profile_command_artifacts(tmp_path, capsys):
     assert os.path.exists(os.path.join(out_dir, "decode_fit.png"))
 
 
+def test_profile_command_gpt2_preset(tmp_path, capsys):
+    """cmd_profile dispatches init on model_type — gpt2 presets work too
+    (ADVICE r2 low: the --preset path was llama-only)."""
+    out_dir = str(tmp_path / "prof_gpt2")
+    rc = cli.main(
+        [
+            "profile", "--preset", "tiny_gpt2", "--out", out_dir,
+            "--dtype", "f32", "--decode-tokens", "4",
+        ]
+    )
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["prefill"]["capability_c_k"] > 0
+    assert payload["config"]["model_type"] == "gpt2"
+
+
+def test_profile_hbm_gib_flag(tmp_path, capsys):
+    """Explicit --hbm-gib drives max_layers_fit deterministically."""
+    out_dir = str(tmp_path / "prof_hbm")
+    rc = cli.main(
+        [
+            "profile", "--preset", "tiny_llama", "--out", out_dir,
+            "--dtype", "f32", "--decode-tokens", "4", "--hbm-gib", "16",
+        ]
+    )
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    # tiny_llama trivially fits 16 GiB: every layer fits
+    assert payload["max_layers_fit"] == payload["config"]["num_hidden_layers"]
+
+
+def test_sharded_head_stage_mismatch_raises():
+    """A head pre-stacked for S stages must not silently mis-slice on a mesh
+    with a different pipe size (ADVICE r2 medium)."""
+    from llm_sharding_tpu.parallel.head import shard_head_host
+    from llm_sharding_tpu.parallel.pipeline import ensure_sharded_head
+
+    params = llama.init_params(CFG, jax.random.key(1), dtype=jnp.float32)
+    head_host = {k: np.asarray(v) for k, v in params.items() if k != "layers"}
+    sharded4 = shard_head_host(CFG, head_host, 4)
+    with pytest.raises(ValueError, match="4 stages"):
+        ensure_sharded_head(CFG, sharded4, 2)
+
+
+def test_shared_server_rejects_overlong_prompt(shards, monkeypatch):
+    """Prompts beyond the largest admit bucket get a real error, not a bare
+    StopIteration (ADVICE r2 low)."""
+    from llm_sharding_tpu.runtime.engine import PipelineEngine
+
+    eng = PipelineEngine.from_shards(shards, num_stages=4, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="admission bucket"):
+        eng._shared_server(5000, 16)
+
+
 def test_convert_requires_weights(tmp_path):
     src = tmp_path / "empty_model"
     src.mkdir()
