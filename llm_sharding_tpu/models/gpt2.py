@@ -22,7 +22,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
-from ..ops.flash_attention import attention_prefill
+from ..ops.flash_attention import attention_step
 from ..ops.norms import layer_norm
 from .cache import KVCache
 from .config import ModelConfig
@@ -99,7 +99,7 @@ def decoder_layer(
     k_row = jax.lax.dynamic_update_slice(k_row, k.astype(k_row.dtype), (0, length, 0, 0))
     v_row = jax.lax.dynamic_update_slice(v_row, v.astype(v_row.dtype), (0, length, 0, 0))
 
-    attn = attention_prefill(q, k_row, v_row, positions, kv_positions)
+    attn = attention_step(q, k_row, v_row, positions, kv_positions, length)
     h = h + attn.reshape(B, S, H) @ p["w_proj"] + p["b_proj"]
 
     x = layer_norm(h, p["ln2_w"], p["ln2_b"], cfg.layer_norm_epsilon)

@@ -25,7 +25,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
-from ..ops.flash_attention import attention_prefill
+from ..ops.flash_attention import attention_step
 from ..ops.norms import rms_norm
 from ..ops.rope import apply_rope, rope_cos_sin
 from .cache import KVCache
@@ -162,7 +162,7 @@ def decoder_layer(
             v_row, v.astype(v_row.dtype), (0, length, 0, 0)
         )
         rows["k"], rows["v"] = k_r, v_r
-        return attention_prefill(q, k_r, v_r, positions, kv_positions)
+        return attention_step(q, k_r, v_r, positions, kv_positions, length)
 
     h = attn_mlp_block(cfg, p, h, cos, sin, attn_fn, tp_axis)
     return h, rows["k"], rows["v"]

@@ -196,3 +196,28 @@ def attention_prefill(
     if use_pallas:
         return flash_attention(q, k_cache, v_cache, q_positions, kv_positions, scale)
     return cached_attention(q, k_cache, v_cache, q_positions, kv_positions, scale)
+
+
+def attention_step(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    kv_positions: jnp.ndarray,
+    length: jnp.ndarray,  # scalar write offset (pre-write) from the KVCache
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Shape-dispatched attention: decode steps (S=1, static under jit) take
+    the plain XLA path — already score-tensor-free at S=1; the real
+    full-capacity-read fix is HOST-level cache segmentation in
+    ``runtime/generate.py`` (an in-program ``lax.switch`` over bucket slices
+    was measured SLOWER on v5e — 62 vs 75 tok/s at C=4096 — because XLA
+    copies the full cache operand into the selected branch, per layer per
+    step). Prefill keeps the flash/XLA selection. ``length`` is accepted so
+    model layers stay agnostic to the dispatch policy."""
+    del length
+    if q.shape[1] == 1:
+        return cached_attention(
+            q, k_cache, v_cache, q_positions, kv_positions, scale
+        )
+    return attention_prefill(q, k_cache, v_cache, q_positions, kv_positions, scale)

@@ -46,6 +46,42 @@ def test_flash_ragged_block_q_padding():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
 
+def test_bucketed_decode_matches_full_capacity():
+    """Decode-shaped attention over the live-length bucket == attention over
+    the whole capacity, for lengths straddling every bucket boundary."""
+    from llm_sharding_tpu.ops.attention import bucketed_decode_attention
+
+    B, C, Nh, Nkv, D = 2, 1024, 4, 2, 64
+    k = _rand((B, C, Nkv, D), 10)
+    v = _rand((B, C, Nkv, D), 11)
+    for live in (3, 255, 256, 257, 600, 1023):
+        q = _rand((B, 1, Nh, D), 12 + live)
+        q_pos = jnp.full((B, 1), live, jnp.int32)
+        kv_pos = jnp.where(jnp.arange(C) <= live, jnp.arange(C), POS_SENTINEL)
+        kv_pos = jnp.broadcast_to(kv_pos[None], (B, C)).astype(jnp.int32)
+        want = cached_attention(q, k, v, q_pos, kv_pos)
+        got = bucketed_decode_attention(
+            q, k, v, q_pos, kv_pos, jnp.int32(live)
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_bucketed_decode_small_capacity_passthrough():
+    """Capacity at/below the min bucket degrades to plain cached_attention."""
+    from llm_sharding_tpu.ops.attention import bucketed_decode_attention
+
+    B, C, Nh, Nkv, D = 1, 64, 2, 2, 32
+    q = _rand((B, 1, Nh, D), 20)
+    k = _rand((B, C, Nkv, D), 21)
+    v = _rand((B, C, Nkv, D), 22)
+    q_pos = jnp.full((B, 1), 10, jnp.int32)
+    kv_pos = jnp.where(jnp.arange(C) <= 10, jnp.arange(C), POS_SENTINEL)
+    kv_pos = jnp.broadcast_to(kv_pos[None], (B, C)).astype(jnp.int32)
+    want = cached_attention(q, k, v, q_pos, kv_pos)
+    got = bucketed_decode_attention(q, k, v, q_pos, kv_pos, jnp.int32(10))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
 def test_flash_with_padded_rows():
     """Sentinel query positions (padded batch rows) stay finite and match."""
     B, S, C, Nh, Nkv, D = 2, 8, 16, 2, 2, 128

@@ -106,6 +106,24 @@ def test_eos_stops_generation(params):
         assert len(gen) == 50
 
 
+def test_segmented_decode_matches_single_program(params):
+    """A large-capacity request decodes across several cache segments
+    (256 -> 1024 -> C); tokens must match a single-segment run exactly —
+    prefix-slice attention is bitwise-identical (masked slots contribute 0)."""
+    from llm_sharding_tpu.runtime.generate import _segment_capacities
+
+    cfg = tiny_llama(max_position_embeddings=8192)
+    prompt = np.array([[3, 9, 2, 7, 5]], dtype=np.int32)
+    N = 40
+    assert len(_segment_capacities(6, 2048)) > 1
+    assert _segment_capacities(6, 300) == [300]  # near-fit: one segment
+
+    r_seg = generate(cfg, params, prompt, N, capacity=2048, cache_dtype=jnp.float32)
+    r_one = generate(cfg, params, prompt, N, cache_dtype=jnp.float32)  # cap 45
+    np.testing.assert_array_equal(r_seg.tokens[:, : 5 + N], r_one.tokens)
+    np.testing.assert_array_equal(r_seg.lengths, r_one.lengths)
+
+
 def test_capacity_overflow_rejected(params):
     with pytest.raises(ValueError, match="capacity"):
         generate(CFG, params, np.arange(4, dtype=np.int32), 10, capacity=8)
