@@ -7,9 +7,14 @@ fp32 accumulation. Same position-based masking contract as
 ``cached_attention`` (``kv_pos <= q_pos``; sentinel = masked) so it is a
 drop-in for prefill over the KV cache.
 
-Grid: (B, Nh, S/BLOCK_Q, C/BLOCK_K) — the KV dimension is innermost and
-sequential; scratch accumulators (acc, m, l) carry the online softmax across
-KV blocks (standard flash attention recurrence). Masking uses -1e30 (not
+Grid: (B, Nkv, G·S/BLOCK_Q, C/BLOCK_K) — GQA-aware: the G query heads that
+share a KV head are FOLDED into the query-row axis before the kernel, so each
+KV block is streamed from HBM once per KV head, not once per query head (G×
+less KV traffic at llama3-8b geometry, G=4). The fold is exact because the
+causal mask depends only on each row's position, which tiles across the G
+copies. The KV dimension is innermost and sequential; scratch accumulators
+(acc, m, l) carry the online softmax across KV blocks (standard flash
+attention recurrence). Masking uses -1e30 (not
 -inf): a block that is entirely future/padding contributes p=1 rows under a
 still--1e30 running max, and the first real block's correction factor
 exp(-1e30 - m_real) = 0 wipes that garbage — so fully-masked prefixes need no
@@ -121,15 +126,7 @@ def flash_attention(
     if scale is None:
         scale = D ** -0.5
 
-    block_q = min(BLOCK_Q, S)
     block_k = min(BLOCK_K, C)
-    pad_q = (-S) % block_q
-    if pad_q:
-        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
-        q_positions = jnp.pad(
-            q_positions, ((0, 0), (0, pad_q)), constant_values=jnp.int32(2**30)
-        )
-    Sp = S + pad_q
     pad_k = (-C) % block_k
     if pad_k:
         k_cache = jnp.pad(k_cache, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
@@ -141,27 +138,41 @@ def flash_attention(
     Cp = C + pad_k
     kv_blocks = Cp // block_k
 
+    # GQA fold: [B, S, Nh, D] -> [B, Nkv, G*S, D]. Head index h = k*G + g
+    # (the reshape contract shared with ``cached_attention``), so folded row
+    # g*S + s carries query head (k, g) at sequence position s, and its
+    # position is q_positions[s] — tiled G times below. Each (b, k) grid cell
+    # now covers ALL G query heads of KV head k: the KV block is fetched once.
+    qh = jnp.transpose(q, (0, 2, 1, 3)).reshape(B, Nkv, G * S, D)
+    qp = jnp.tile(q_positions, (1, G))  # [B, G*S]
+    L = G * S
+    block_q = min(BLOCK_Q, L)
+    pad_q = (-L) % block_q
+    if pad_q:
+        qh = jnp.pad(qh, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+        qp = jnp.pad(qp, ((0, 0), (0, pad_q)), constant_values=jnp.int32(2**30))
+    Lp = L + pad_q
+
     # head-major layouts for Mosaic (sublane, lane) = (seq, head_dim) tiling
-    qh = jnp.transpose(q, (0, 2, 1, 3))  # [B, Nh, Sp, D]
     kh = jnp.transpose(k_cache, (0, 2, 1, 3))  # [B, Nkv, Cp, D]
     vh = jnp.transpose(v_cache, (0, 2, 1, 3))
-    qp = q_positions[..., None]  # [B, Sp, 1] — sublane-major (see kernel)
+    qp = qp[..., None]  # [B, Lp, 1] — sublane-major (see kernel)
     kp = kv_positions[:, None, :]  # [B, 1, Cp] — lane-major
 
-    grid = (B, Nh, Sp // block_q, kv_blocks)
+    grid = (B, Nkv, Lp // block_q, kv_blocks)
     out = pl.pallas_call(
         functools.partial(_flash_kernel, scale=scale, kv_blocks=kv_blocks),
-        out_shape=jax.ShapeDtypeStruct((B, Nh, Sp, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, Nkv, Lp, D), q.dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // G, j, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // G, j, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, h, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_k), lambda b, h, i, j: (b, 0, j)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, k, i, j: (b, k, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, k, i, j: (b, k, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, k, i, j: (b, k, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, k, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, k, i, j: (b, 0, j)),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)
+            (1, 1, block_q, D), lambda b, k, i, j: (b, k, i, 0)
         ),
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
@@ -173,7 +184,8 @@ def flash_attention(
         ),
         interpret=interpret,
     )(qh, kh, vh, qp, kp)
-    return jnp.transpose(out, (0, 2, 1, 3))[:, :S]
+    out = out[:, :, :L].reshape(B, Nkv, G, S, D)
+    return jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(B, S, Nh, D)
 
 
 def attention_prefill(

@@ -101,3 +101,20 @@ def test_flash_with_padded_rows():
         np.asarray(got)[1, :5], np.asarray(want)[1, :5], atol=2e-5
     )
     np.testing.assert_allclose(np.asarray(got)[0], np.asarray(want)[0], atol=2e-5)
+
+
+def test_flash_gqa_fold_llama3_geometry():
+    """G=4 (llama3-8b head geometry: 32 q heads / 8 kv heads — scaled down in
+    head count, exact in G) exercises the GQA fold: query heads sharing a KV
+    head ride one folded row axis, with S not a multiple of the query block."""
+    B, S, C, Nh, Nkv, D = 2, 33, 128, 8, 2, 128
+    q = _rand((B, S, Nh, D), 30)
+    k = _rand((B, C, Nkv, D), 31)
+    v = _rand((B, C, Nkv, D), 32)
+    q_pos = jnp.broadcast_to(jnp.arange(S), (B, S)).astype(jnp.int32)
+    kv_pos = jnp.where(jnp.arange(C) < S, jnp.arange(C), POS_SENTINEL)[None]
+    kv_pos = jnp.broadcast_to(kv_pos, (B, C)).astype(jnp.int32)
+
+    want = cached_attention(q, k, v, q_pos, kv_pos)
+    got = flash_attention(q, k, v, q_pos, kv_pos, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
