@@ -1,18 +1,35 @@
-"""Benchmark: greedy decode throughput on the real chip.
+"""Benchmark suite: the judged surface, measured on the real chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line PER METRIC: {"metric", "value", "unit", "vs_baseline"}.
+The headline metric (3B single-chip greedy decode, the round-1/2 metric,
+unchanged methodology) is printed LAST so drivers that keep only the final
+line still record it.
 
-Model: a Llama-3.2-3B-class config — the model family the reference's
-anecdotal anchor was measured on (~4 tok/s on the author's edge node at
-max_new_tokens=1024, `/root/reference/start_node.py:20` comment; BASELINE.md
-"anecdotal runtime anchor"). vs_baseline is decode tok/s divided by that
-4 tok/s anchor — the only number the reference world provides.
+Metrics (VERDICT r2 next-#2):
+  a. decode_tok_s_llama2-7b_1chip   — largest 7B-family config on one chip
+     (Llama-2-7B bf16 ~13.5 GB; if it doesn't fit, an explicit error line is
+     emitted — no silent downgrade).
+  b. serve_tok_s_llama3.2-3b_1stage — steady-state continuous-batching
+     throughput: serve_admit + serve_chunk on a 1-stage mesh (the
+     PipelineServer path, previously never timed on hardware).
+  c. pallas_prefill_speedup_s2048   — fused flash-attention kernel vs the XLA
+     score-materializing path at S=C=2048, llama3-8b head geometry, with an
+     on-chip numeric cross-check (bf16).
+  d. decode_tok_s_llama3.2-3b_1chip_c4096 — decode against a 4096-slot KV
+     cache (segmented-decode path; r2 weak #3).
+  e. decode_tok_s_llama3.2-3b_1chip — the no-regression anchor metric.
 
-Weights are random (throughput is weight-value independent); bf16; full model
-on one chip; decode runs inside one compiled while_loop program via
-runtime.generate.
+vs_baseline for throughput metrics is tok/s divided by the reference world's
+only number: the ~4 tok/s anecdotal anchor (`/root/reference/start_node.py:20`
+comment; BASELINE.md). For the kernel metric it is the speedup itself (XLA
+path = 1.0).
+
+Weights are random (throughput is weight-value independent); bf16 everywhere.
+On non-TPU hosts every section falls back to a tiny config (smoke mode) and
+metric names change, so CPU lines can never be mistaken for chip numbers.
 """
 
+import gc
 import json
 import os
 import sys
@@ -21,54 +38,238 @@ import time
 import numpy as np
 
 
-def main():
-    import jax
-    import jax.numpy as jnp
+def emit(metric, value, unit, vs_baseline, **extra):
+    line = {
+        "metric": metric,
+        "value": round(float(value), 2),
+        "unit": unit,
+        "vs_baseline": round(float(vs_baseline), 2),
+    }
+    line.update(extra)
+    print(json.dumps(line), flush=True)
 
+
+def emit_error(metric, unit, err):
+    emit(metric, 0.0, unit, 0.0, error=str(err)[:300])
+
+
+ANCHOR_TOK_S = 4.0  # BASELINE.md anecdotal anchor
+
+
+def time_decode(cfg, params, prompt_len, max_new, capacity, generate):
+    """Compile (warm-up) then time one full generate() call — the reference
+    profiler's warm-up + synchronize discipline
+    (`/root/reference/utils/node_profiler.py:860-891`): generate() blocks on
+    host fetch of the result, so perf_counter brackets real execution."""
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, (1, prompt_len)).astype(np.int32)
+    generate(cfg, params, prompt, max_new, capacity=capacity)
+    t0 = time.perf_counter()
+    res = generate(cfg, params, prompt, max_new, capacity=capacity)
+    elapsed = time.perf_counter() - t0
+    generated = int(res.lengths[0]) - prompt_len
+    return generated / elapsed
+
+
+def bench_7b(on_tpu, jax, jnp):
     from llm_sharding_tpu.models import llama
-    from llm_sharding_tpu.models.config import llama32_3b
+    from llm_sharding_tpu.models.config import llama2_7b, tiny_llama
     from llm_sharding_tpu.runtime.generate import generate
 
-    dev = jax.devices()[0]
-    on_tpu = dev.platform != "cpu"
+    if on_tpu:
+        name, cfg = "decode_tok_s_llama2-7b_1chip", llama2_7b()
+        prompt_len, max_new = 32, 256
+    else:
+        name, cfg = "decode_tok_s_7b-proxy_cpu", tiny_llama(num_hidden_layers=8)
+        prompt_len, max_new = 8, 16
+    params = llama.init_params(cfg, jax.random.key(0), dtype=jnp.bfloat16)
+    tok_s = time_decode(
+        cfg, params, prompt_len, max_new, prompt_len + max_new, generate
+    )
+    emit(name, tok_s, "tokens/sec", tok_s / ANCHOR_TOK_S)
+    del params
+    gc.collect()
+
+
+def bench_3b(on_tpu, jax, jnp):
+    """3B monolith decode at tight capacity (the anchor metric, methodology
+    identical to rounds 1-2) and at C=4096 (segmented decode, r2 weak #3).
+    Returns host-resident numpy params for the serve bench so the monolithic
+    device copy can be freed before the engine re-device_puts them."""
+    from llm_sharding_tpu.models import llama
+    from llm_sharding_tpu.models.config import llama32_3b, tiny_llama
+    from llm_sharding_tpu.runtime.generate import generate
 
     if on_tpu:
         cfg = llama32_3b()
         prompt_len, max_new = 32, 256
-    else:  # CPU fallback so the bench is runnable anywhere
-        from llm_sharding_tpu.models.config import tiny_llama
-
-        cfg = tiny_llama()
-        prompt_len, max_new = 8, 32
-
-    params = llama.init_params(cfg, jax.random.key(0), dtype=jnp.bfloat16)
-    rng = np.random.default_rng(0)
-    prompt = rng.integers(0, cfg.vocab_size, (1, prompt_len)).astype(np.int32)
-
-    # Warm-up / compile (the discipline the reference profiler applies at
-    # /root/reference/utils/node_profiler.py:860-878). Must use the SAME
-    # static args (max_new_tokens, capacity) as the timed run — a different
-    # max_new is a different compiled program and the timing would include
-    # compilation.
-    generate(cfg, params, prompt, max_new, capacity=prompt_len + max_new)
-
-    t0 = time.perf_counter()
-    res = generate(cfg, params, prompt, max_new, capacity=prompt_len + max_new)
-    elapsed = time.perf_counter() - t0
-
-    generated = int(res.lengths[0]) - prompt_len
-    tok_s = generated / elapsed
-
-    print(
-        json.dumps(
-            {
-                "metric": "decode_tok_s_llama3.2-3b_1chip" if on_tpu else "decode_tok_s_tiny_cpu",
-                "value": round(tok_s, 2),
-                "unit": "tokens/sec",
-                "vs_baseline": round(tok_s / 4.0, 2),
-            }
+        big_c = 4096
+        names = (
+            "decode_tok_s_llama3.2-3b_1chip_c4096",
+            "decode_tok_s_llama3.2-3b_1chip",
         )
+    else:
+        cfg = tiny_llama()
+        prompt_len, max_new = 8, 16
+        big_c = 128
+        names = ("decode_tok_s_tiny_cpu_cbig", "decode_tok_s_tiny_cpu")
+    params = llama.init_params(cfg, jax.random.key(0), dtype=jnp.bfloat16)
+
+    try:
+        tok_s_big = time_decode(cfg, params, prompt_len, max_new, big_c, generate)
+        emit(names[0], tok_s_big, "tokens/sec", tok_s_big / ANCHOR_TOK_S)
+    except Exception as e:  # noqa: BLE001 — report, keep benching
+        emit_error(names[0], "tokens/sec", e)
+
+    tok_s = time_decode(
+        cfg, params, prompt_len, max_new, prompt_len + max_new, generate
     )
+    params_np = jax.tree.map(np.asarray, params)
+    del params
+    gc.collect()
+    return cfg, params_np, names[1], tok_s
+
+
+def bench_serve(on_tpu, cfg, params_np, jax, jnp):
+    """Steady-state continuous-batching throughput on a 1-stage mesh: the
+    serve_admit + serve_chunk programs (`parallel/serve.py`) driven by the
+    PipelineServer daemon loop (`runtime/server.py`)."""
+    from llm_sharding_tpu.runtime.engine import PipelineEngine
+
+    name = (
+        "serve_tok_s_llama3.2-3b_1stage" if on_tpu else "serve_tok_s_tiny_cpu"
+    )
+    if on_tpu:
+        batch_per_slot, capacity, chunk_cycles = 4, 512, 8
+        prompt_len, max_new = 32, 256
+    else:
+        batch_per_slot, capacity, chunk_cycles = 2, 64, 2
+        prompt_len, max_new = 8, 16
+
+    engine = PipelineEngine(
+        cfg, params_np, num_stages=1, devices=jax.devices()[:1]
+    )
+    rng = np.random.default_rng(1)
+
+    def run(n_requests, n_new):
+        srv = engine.serve(
+            capacity=capacity,
+            batch_per_slot=batch_per_slot,
+            chunk_cycles=chunk_cycles,
+        )
+        for _ in range(n_requests):
+            srv.submit(
+                rng.integers(0, cfg.vocab_size, prompt_len).astype(np.int32),
+                max_new_tokens=n_new,
+            )
+        srv.run_until_idle()
+        return srv
+
+    run(1, 4)  # compile admit + chunk programs
+    t0 = time.perf_counter()
+    srv = run(batch_per_slot, max_new)
+    elapsed = time.perf_counter() - t0
+    tok_s = srv.counters.tokens_generated / elapsed
+    emit(name, tok_s, "tokens/sec", tok_s / ANCHOR_TOK_S)
+    del engine, srv
+    gc.collect()
+
+
+def bench_pallas(on_tpu, jax, jnp):
+    """Fused flash-attention kernel vs the XLA path: prefill latency at
+    S=C=2048, llama3-8b head geometry (32 q / 8 kv / D=128), bf16, plus an
+    on-chip numeric cross-check. Timing chains each iteration's output into
+    the next call's operand so the device can't overlap the repeats."""
+    from llm_sharding_tpu.ops.attention import cached_attention
+    from llm_sharding_tpu.ops.flash_attention import flash_attention
+
+    name = "pallas_prefill_speedup_s2048" if on_tpu else "pallas_prefill_speedup_cpu"
+    if not on_tpu:
+        # the kernel needs a real TPU (interpret mode measures nothing) —
+        # emit an honest placeholder so the metric list is stable
+        emit(name, 1.0, "x_speedup_vs_xla", 1.0, note="cpu smoke: kernel not run")
+        return
+
+    B, S, C, Nh, Nkv, D = 1, 2048, 2048, 32, 8, 128
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(kq, (B, S, Nh, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, C, Nkv, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, C, Nkv, D), jnp.bfloat16)
+    qpos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    kvpos = jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32), (B, C))
+
+    out_p = flash_attention(q, k, v, qpos, kvpos)
+    out_x = cached_attention(q, k, v, qpos, kvpos)
+    diff = float(
+        jnp.max(jnp.abs(out_p.astype(jnp.float32) - out_x.astype(jnp.float32)))
+    )
+    if diff > 0.05:  # bf16 at unit-normal scale: one-ulp-level agreement
+        raise AssertionError(f"pallas/XLA mismatch on chip: max|d|={diff}")
+
+    def timed(fn, n=10):
+        x = q
+        fn(x, k, v, qpos, kvpos).block_until_ready()  # warm
+        t0 = time.perf_counter()
+        for _ in range(n):
+            # chain: feed the output back in so iterations serialize
+            x = fn(x, k, v, qpos, kvpos)
+        x.block_until_ready()
+        return (time.perf_counter() - t0) / n
+
+    t_pallas = timed(flash_attention)
+    t_xla = timed(cached_attention)
+    emit(
+        name,
+        t_xla / t_pallas,
+        "x_speedup_vs_xla",
+        t_xla / t_pallas,
+        pallas_ms=round(t_pallas * 1e3, 2),
+        xla_ms=round(t_xla * 1e3, 2),
+        max_abs_diff=round(diff, 4),
+    )
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+
+    try:
+        bench_7b(on_tpu, jax, jnp)
+    except Exception as e:  # noqa: BLE001
+        emit_error("decode_tok_s_llama2-7b_1chip", "tokens/sec", e)
+        gc.collect()
+
+    ret = None
+    try:
+        ret = bench_3b(on_tpu, jax, jnp)
+    except Exception as e:  # noqa: BLE001
+        emit_error("decode_tok_s_llama3.2-3b_1chip", "tokens/sec", e)
+        gc.collect()
+
+    if ret is not None:
+        cfg, params_np, anchor_name, anchor_tok_s = ret
+        try:
+            bench_serve(on_tpu, cfg, params_np, jax, jnp)
+        except Exception as e:  # noqa: BLE001
+            emit_error("serve_tok_s_llama3.2-3b_1stage", "tokens/sec", e)
+        del params_np
+        gc.collect()
+    else:
+        emit_error(
+            "serve_tok_s_llama3.2-3b_1stage", "tokens/sec",
+            "not attempted: 3B section failed",
+        )
+
+    try:
+        bench_pallas(on_tpu, jax, jnp)
+    except Exception as e:  # noqa: BLE001
+        emit_error("pallas_prefill_speedup_s2048", "x_speedup_vs_xla", e)
+
+    if ret is not None:
+        # headline LAST (drivers that keep one line keep this one)
+        emit(anchor_name, anchor_tok_s, "tokens/sec", anchor_tok_s / ANCHOR_TOK_S)
 
 
 if __name__ == "__main__":

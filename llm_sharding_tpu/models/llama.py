@@ -50,9 +50,12 @@ def init_layer_params(
     L = num_layers
 
     def w(k, *shape):
+        # Sample directly in the target dtype: a stacked fp32 intermediate for
+        # a 7B-class leaf ([32, 4096, 11008] = 5.8 GB) would not fit HBM on
+        # top of the already-materialized bf16 leaves.
         fan_in = shape[-2]
-        return (jax.random.normal(k, (L, *shape), jnp.float32) * fan_in**-0.5).astype(
-            dtype
+        return jax.random.normal(k, (L, *shape), dtype) * jnp.asarray(
+            fan_in**-0.5, dtype
         )
 
     return {

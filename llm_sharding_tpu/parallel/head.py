@@ -132,17 +132,12 @@ def sp_embed(
     return h
 
 
-def sp_next_token(
-    cfg: ModelConfig,
-    head: HeadParams,  # local view
-    h_last: jnp.ndarray,  # [B, H] final-depth hidden, replicated across stages
-) -> jnp.ndarray:
-    """Greedy next token over the vocab-sharded head → [B] int32, replicated.
-
-    Each stage computes only its [B, V/S] logit slice (the full-vocab matmul
-    is distributed, not replicated); the global argmax is assembled from
-    per-shard (max, argmax) pairs with one all_gather.
-    """
+def _local_logits(
+    cfg: ModelConfig, head: HeadParams, h_last: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Final norm + this stage's [B, V/S] fp32 logit slice (pad columns
+    already masked to -inf). Returns (logits, lo) with ``lo`` the slice's
+    global vocab offset."""
     if cfg.model_type == "gpt2":
         x = layer_norm(
             h_last, head["final_norm"], head["final_norm_bias"],
@@ -158,16 +153,134 @@ def sp_next_token(
     sidx = jax.lax.axis_index(PIPE_AXIS)
     lo = sidx * Vs
     col_ok = (lo + jnp.arange(Vs, dtype=jnp.int32)) < cfg.vocab_size
-    logits = jnp.where(col_ok[None, :], logits, -jnp.inf)
+    return jnp.where(col_ok[None, :], logits, -jnp.inf), lo
 
-    loc_max = jnp.max(logits, axis=-1)  # [B]
-    loc_arg = jnp.argmax(logits, axis=-1).astype(jnp.int32) + lo  # [B]
+
+def _assemble_argmax(vals: jnp.ndarray, lo: jnp.ndarray) -> jnp.ndarray:
+    """Global argmax over vocab-sharded [B, V/S] values → [B] int32 global
+    vocab ids, replicated. One all_gather of 2 scalars per row."""
+    loc_max = jnp.max(vals, axis=-1)  # [B]
+    loc_arg = jnp.argmax(vals, axis=-1).astype(jnp.int32) + lo  # [B]
     maxs = jax.lax.all_gather(loc_max, PIPE_AXIS)  # [S, B]
     args = jax.lax.all_gather(loc_arg, PIPE_AXIS)  # [S, B]
     # argmax over stages picks the LOWEST stage on ties = lowest vocab index,
     # matching jnp.argmax over the unsharded vocab.
     best = jnp.argmax(maxs, axis=0)  # [B]
     return jnp.take_along_axis(args, best[None, :], axis=0)[0]
+
+
+def sp_next_token(
+    cfg: ModelConfig,
+    head: HeadParams,  # local view
+    h_last: jnp.ndarray,  # [B, H] final-depth hidden, replicated across stages
+) -> jnp.ndarray:
+    """Greedy next token over the vocab-sharded head → [B] int32, replicated.
+
+    Each stage computes only its [B, V/S] logit slice (the full-vocab matmul
+    is distributed, not replicated); the global argmax is assembled from
+    per-shard (max, argmax) pairs with one all_gather.
+    """
+    logits, lo = _local_logits(cfg, head, h_last)
+    return _assemble_argmax(logits, lo)
+
+
+def _topk_threshold(scaled: jnp.ndarray, top_k: int) -> jnp.ndarray:
+    """Global k-th-largest of vocab-sharded [B, V/S] values → [B, 1].
+
+    The global top-k is a subset of the union of per-shard top-k's, so
+    gathering k values per shard and re-selecting reproduces the monolithic
+    ``lax.top_k(full, k)[0][:, -1]`` bitwise."""
+    Vs = scaled.shape[-1]
+    kk = min(top_k, Vs)
+    loc = jax.lax.top_k(scaled, kk)[0]  # [B, kk]
+    allk = jax.lax.all_gather(loc, PIPE_AXIS)  # [S, B, kk]
+    merged = jnp.transpose(allk, (1, 0, 2)).reshape(allk.shape[1], -1)
+    return jax.lax.top_k(merged, top_k)[0][:, -1:]
+
+
+def _sliced_gumbel(
+    noise_full: jnp.ndarray,  # [B, V] — the monolith's noise, regenerated
+    vocab_size: int,
+    num_stages: int,
+) -> jnp.ndarray:
+    """Each stage's [B, V/S] column slice of the full noise field. Slicing a
+    replicated regeneration (0.5 MB/step at V=128k — negligible next to the
+    matmuls) is what makes sharded draws EQUAL to monolithic draws."""
+    B = noise_full.shape[0]
+    Vs = vocab_shard_size(vocab_size, num_stages)
+    pad = Vs * num_stages - vocab_size
+    if pad:
+        noise_full = jnp.concatenate(
+            [noise_full, jnp.zeros((B, pad), noise_full.dtype)], axis=1
+        )
+    sidx = jax.lax.axis_index(PIPE_AXIS)
+    return jax.lax.dynamic_slice_in_dim(noise_full, sidx * Vs, Vs, axis=1)
+
+
+def sp_sample(
+    cfg: ModelConfig,
+    head: HeadParams,  # local view
+    h_last: jnp.ndarray,  # [B, H] replicated
+    key: jnp.ndarray,  # replicated PRNG key (typed or raw uint32 data)
+    temperature: float,  # static; <= 0 → greedy
+    top_k: int,  # static
+    num_stages: int,  # static
+) -> jnp.ndarray:
+    """Seeded sampling over the vocab-sharded head → [B] int32, replicated.
+
+    Token-exact vs the monolithic ``ops.sampling.sample`` with the same key:
+    the top-k threshold is assembled from per-shard top-k's (bitwise equal to
+    the global one), and the Gumbel noise is regenerated in full on every
+    stage from the replicated key, then column-sliced — so each shard
+    perturbs its logits with exactly the noise values the monolith would.
+    """
+    if temperature <= 0.0:
+        return sp_next_token(cfg, head, h_last)
+    if jnp.issubdtype(key.dtype, jnp.integer):
+        key = jax.random.wrap_key_data(key)
+    logits, lo = _local_logits(cfg, head, h_last)
+    scaled = logits / temperature
+    if top_k > 0:
+        kth = _topk_threshold(scaled, top_k)
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    g_full = jax.random.gumbel(
+        key, (h_last.shape[0], cfg.vocab_size), jnp.float32
+    )
+    g = _sliced_gumbel(g_full, cfg.vocab_size, num_stages)
+    return _assemble_argmax(scaled + g, lo)
+
+
+def sp_sample_rows(
+    cfg: ModelConfig,
+    head: HeadParams,  # local view
+    h_last: jnp.ndarray,  # [B, H] replicated
+    row_keys: jnp.ndarray,  # [B, 2] raw uint32 key data, one chain per row
+    temperature: jnp.ndarray,  # [B] f32; <= 0 → greedy for that row
+    top_k: int,  # static (server-level)
+    num_stages: int,  # static
+) -> jnp.ndarray:
+    """Per-row seeded sampling (the serving path: each slot row carries its
+    own request's key chain and temperature). A row with temperature t>0 and
+    key chain seeded like the monolith's draws the monolith's B=1 tokens
+    exactly; t<=0 rows are greedy."""
+    logits, lo = _local_logits(cfg, head, h_last)
+    greedy = _assemble_argmax(logits, lo)
+
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / safe_t[:, None]
+    if top_k > 0:
+        kth = _topk_threshold(scaled, top_k)
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    # per-row noise: gumbel(key, (1, V)) row-reshaped == gumbel(key, (V,)),
+    # so each row reproduces a B=1 monolith draw
+    g_full = jax.vmap(
+        lambda kd: jax.random.gumbel(
+            jax.random.wrap_key_data(kd), (cfg.vocab_size,), jnp.float32
+        )
+    )(row_keys)
+    g = _sliced_gumbel(g_full, cfg.vocab_size, num_stages)
+    sampled = _assemble_argmax(scaled + g, lo)
+    return jnp.where(temperature > 0, sampled, greedy)
 
 
 def head_bytes_per_stage(

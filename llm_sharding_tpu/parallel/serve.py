@@ -43,7 +43,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.cache import KVCache, POS_SENTINEL
 from ..models.config import ModelConfig
 from ..ops.sampling import is_stop as _is_stop
-from .head import head_specs, local_view, psum_from, sp_embed, sp_next_token
+from .head import (
+    head_specs, local_view, psum_from, sp_embed, sp_sample_rows,
+)
 from .mesh import PIPE_AXIS
 from .pipeline import model_fns, ring_chain
 
@@ -68,6 +70,8 @@ class ServeState(NamedTuple):
     budget: jax.Array     # [M] max total length (prompt + max_new) per row
     inject: jax.Array     # [M, 1, H] pending stage-0 injection embeddings
     inject_pending: jax.Array  # [M] bool
+    rng: jax.Array        # [M, 2] raw uint32 PRNG key data, one chain per row
+    temp: jax.Array       # [M] f32 sampling temperature (<= 0 → greedy)
     m: jax.Array          # scalar int32 microstep counter
 
 
@@ -77,7 +81,7 @@ def state_specs(state: ServeState) -> ServeState:
     return ServeState(
         k=dev, v=dev, kpos=dev, h=dev, h_valid=dev, pos_slots=dev,
         write_off=dev, out=rep, lengths=rep, done=rep, budget=rep,
-        inject=rep, inject_pending=rep, m=rep,
+        inject=rep, inject_pending=rep, rng=rep, temp=rep, m=rep,
     )
 
 
@@ -119,13 +123,16 @@ def make_state(
         budget=put(jnp.zeros((M,), jnp.int32), rep),
         inject=put(jnp.zeros((M, 1, H), act_dtype), rep),
         inject_pending=put(jnp.zeros((M,), jnp.bool_), rep),
+        rng=put(jnp.zeros((M, 2), jnp.uint32), rep),
+        temp=put(jnp.zeros((M,), jnp.float32), rep),
         m=put(jnp.zeros((), jnp.int32), rep),
     )
     return state
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "mesh", "num_stages", "cache_dtype")
+    jax.jit,
+    static_argnames=("cfg", "mesh", "num_stages", "cache_dtype", "top_k"),
 )
 def serve_admit(
     cfg: ModelConfig,
@@ -139,8 +146,11 @@ def serve_admit(
     row_valid: jnp.ndarray,   # [Bs] bool — False rows stay free/done
     slot: jnp.ndarray,        # scalar int32
     max_new: jnp.ndarray,     # [Bs] per-row new-token budget
+    seeds: jnp.ndarray,       # [Bs] int32 per-request sampling seeds
+    temperature: jnp.ndarray,  # [Bs] f32; <= 0 → greedy for that row
     num_stages: int,
     cache_dtype,
+    top_k: int = 0,
 ):
     """Prefill ``slot`` with up to Bs new requests while the rest of the
     pipeline state is parked. Returns the updated state."""
@@ -150,7 +160,7 @@ def serve_admit(
     C = state.out.shape[1]
 
     def body(stage_layers, layer_mask, head_params, state, prompts,
-             prompt_len, row_valid, slot, max_new):
+             prompt_len, row_valid, slot, max_new, seeds, temperature):
         layers = jax.tree.map(lambda a: a[0], stage_layers)
         lmask = layer_mask[0]
         hd = local_view(head_params)
@@ -182,7 +192,17 @@ def serve_admit(
             h, (prompt_len - 1)[:, None, None], axis=1
         )[:, 0]
         h_last = psum_from(h_last, 0)
-        tok0 = sp_next_token(cfg, hd, h_last)  # [Bs] replicated
+        # Per-row key chains mirror the monolith's (key(seed) → split →
+        # sample), so a seeded temperature>0 request draws the monolith's
+        # B=1 tokens exactly (r2 weak #8).
+        def mk(s):
+            k, sub = jax.random.split(jax.random.key(s))
+            return jax.random.key_data(k), jax.random.key_data(sub)
+
+        row_keys, subs = jax.vmap(mk)(seeds)  # [Bs, 2] each
+        tok0 = sp_sample_rows(
+            cfg, hd, h_last, subs, temperature, top_k, num_stages
+        )  # [Bs] replicated
         tok0 = jnp.where(row_valid, tok0, 0)
 
         # ---- scatter the slot into the parked state ----
@@ -219,6 +239,12 @@ def serve_admit(
         inject_pending = jax.lax.dynamic_update_slice_in_dim(
             st.inject_pending, row_valid & ~done0, row0, axis=0
         )
+        rng = jax.lax.dynamic_update_slice_in_dim(
+            st.rng, row_keys, row0, axis=0
+        )
+        temp = jax.lax.dynamic_update_slice_in_dim(
+            st.temp, jnp.where(row_valid, temperature, 0.0), row0, axis=0
+        )
 
         # Defense in depth vs stale parked blocks: the device whose next
         # microstep serves this slot currently holds a block belonging to it
@@ -231,7 +257,7 @@ def serve_admit(
             k=k_new, v=v_new, kpos=kpos_new, pos_slots=pos_slots,
             write_off=write_off, out=out, lengths=lengths, budget=budget,
             done=done, inject=inject, inject_pending=inject_pending,
-            h_valid=h_valid,
+            h_valid=h_valid, rng=rng, temp=temp,
         )
         return jax.tree.map(
             lambda spec, leaf: leaf[None] if spec == P(PIPE_AXIS) else leaf,
@@ -244,18 +270,18 @@ def serve_admit(
         mesh=mesh,
         in_specs=(
             P(PIPE_AXIS), P(PIPE_AXIS), head_specs(head_params), specs,
-            P(), P(), P(), P(), P(),
+            P(), P(), P(), P(), P(), P(), P(),
         ),
         out_specs=specs,
         check_vma=False,
     )(stage_layers, layer_masks, head_params, state, prompts, prompt_len,
-      row_valid, slot, max_new)
+      row_valid, slot, max_new, seeds, temperature)
     return out_state
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "mesh", "num_stages", "n_micro"),
+    static_argnames=("cfg", "mesh", "num_stages", "n_micro", "top_k"),
 )
 def serve_chunk(
     cfg: ModelConfig,
@@ -266,6 +292,7 @@ def serve_chunk(
     state: ServeState,
     num_stages: int,
     n_micro: int,
+    top_k: int = 0,
 ):
     """Run ``n_micro`` interleaved microsteps on the live state."""
     fns = model_fns(cfg)
@@ -344,7 +371,20 @@ def serve_chunk(
             valid_done = (
                 psum_from(valid_now.astype(jnp.int32), last) > 0
             )
-            nxt = sp_next_token(cfg, hd, h_done)
+            # Advance each completing row's key chain exactly when it commits
+            # a token — one split per generated token, mirroring the
+            # monolith's decode loop, so seeded draws stay token-exact.
+            rng_rows = jax.lax.dynamic_slice_in_dim(s.rng, rowd, Bs, axis=0)
+
+            def spl(kd):
+                k, sub = jax.random.split(jax.random.wrap_key_data(kd))
+                return jax.random.key_data(k), jax.random.key_data(sub)
+
+            new_keys, subs = jax.vmap(spl)(rng_rows)
+            temp_rows = jax.lax.dynamic_slice_in_dim(s.temp, rowd, Bs)
+            nxt = sp_sample_rows(
+                cfg, hd, h_done, subs, temp_rows, top_k, num_stages
+            )
             nxt = jnp.where(done_rows, 0, nxt)
 
             len_rows = jax.lax.dynamic_slice_in_dim(s.lengths, rowd, Bs)
@@ -354,6 +394,9 @@ def serve_chunk(
             cur = s.out[row_ids, wpos]
             out = s.out.at[row_ids, wpos].set(jnp.where(commit, nxt, cur))
             lengths = s.lengths.at[row_ids].add(commit.astype(jnp.int32))
+            rng = s.rng.at[row_ids].set(
+                jnp.where(commit[:, None], new_keys, rng_rows)
+            )
             new_len = len_rows + commit.astype(jnp.int32)
             done = s.done.at[row_ids].set(
                 done_rows
@@ -391,7 +434,7 @@ def serve_chunk(
                 k=k_st, v=v_st, kpos=kpos_st, h=h_out, h_valid=h_valid_out,
                 pos_slots=pos_slots, write_off=write_off, out=out,
                 lengths=lengths, done=done, inject_pending=inject_pending,
-                m=m + 1,
+                rng=rng, m=m + 1,
             )
 
         st = jax.lax.fori_loop(0, n_micro, micro, st)

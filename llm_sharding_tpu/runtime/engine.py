@@ -183,6 +183,9 @@ class PipelineEngine:
         *,
         prompt_len=None,
         capacity: Optional[int] = None,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        seed: int = 0,
     ) -> PipelineResult:
         with self._lock:
             stage_layers, masks = self.stage_layers, self.layer_masks
@@ -198,6 +201,9 @@ class PipelineEngine:
             prompt_len=prompt_len,
             capacity=capacity,
             cache_dtype=self.cache_dtype,
+            temperature=temperature,
+            top_k=top_k,
+            seed=seed,
         )
 
     def generate_many(
@@ -242,6 +248,7 @@ class PipelineEngine:
         capacity: int = 1024,
         batch_per_slot: int = 1,
         chunk_cycles: int = 1,
+        top_k: int = 0,
     ):
         """Build a continuous-batching server over this engine's sharded
         arrays (≙ the reference's persistent ``run_worker_loop`` daemon,
@@ -253,6 +260,7 @@ class PipelineEngine:
             capacity=capacity,
             batch_per_slot=batch_per_slot,
             chunk_cycles=chunk_cycles,
+            top_k=top_k,
         )
 
     def _shared_server(self, prompt_len: int, max_new: int):

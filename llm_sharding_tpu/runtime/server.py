@@ -69,14 +69,24 @@ class Request:
 
     __slots__ = (
         "id", "prompt", "prompt_len", "max_new", "tokens", "done", "row",
+        "temperature", "seed",
         "submitted_at", "started_at", "finished_at",
     )
 
-    def __init__(self, rid: int, prompt: np.ndarray, max_new: int):
+    def __init__(
+        self,
+        rid: int,
+        prompt: np.ndarray,
+        max_new: int,
+        temperature: float = 0.0,
+        seed: int = 0,
+    ):
         self.id = rid
         self.prompt = prompt
         self.prompt_len = int(prompt.shape[0])
         self.max_new = max_new
+        self.temperature = temperature  # <= 0 → greedy
+        self.seed = seed
         self.tokens: list[int] = []  # generated ids (incl. EOS if produced)
         self.done = False
         self.row: Optional[int] = None
@@ -100,6 +110,7 @@ class PipelineServer:
         capacity: int = 1024,
         batch_per_slot: int = 1,
         chunk_cycles: int = 1,
+        top_k: int = 0,
     ):
         self.engine = engine
         self.cfg = engine.cfg
@@ -108,6 +119,9 @@ class PipelineServer:
         self.batch_per_slot = batch_per_slot
         self.capacity = capacity
         self.chunk_cycles = chunk_cycles
+        # top-k is server-level (a static program parameter — per-request
+        # values would recompile serve_chunk); temperature/seed are per-request
+        self.top_k = top_k
         self.counters = Counters()
 
         Lp = engine.layer_masks.shape[1]
@@ -130,9 +144,19 @@ class PipelineServer:
 
     # ------------------------------------------------------------------ API
 
-    def submit(self, prompt_ids, max_new_tokens: int = 128) -> Request:
+    def submit(
+        self,
+        prompt_ids,
+        max_new_tokens: int = 128,
+        *,
+        temperature: float = 0.0,
+        seed: int = 0,
+    ) -> Request:
         """Enqueue a request (≙ ``receive_user_request``, admission happens
-        on the next ``step``)."""
+        on the next ``step``). ``temperature > 0`` samples with this
+        request's own seeded key chain — token-exact vs the monolithic
+        ``generate(..., temperature=, seed=)`` at B=1 (top-k is server-level,
+        see ``top_k`` in the constructor)."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         bucket = self._bucket(prompt.shape[0])
         total = bucket + max_new_tokens
@@ -146,7 +170,10 @@ class PipelineServer:
                 f"requested {total} positions > max_position_embeddings "
                 f"({self.cfg.max_position_embeddings})"
             )
-        req = Request(next(self._ids), prompt, max_new_tokens)
+        req = Request(
+            next(self._ids), prompt, max_new_tokens,
+            temperature=temperature, seed=seed,
+        )
         self._queue.append(req)
         self.counters.requests_submitted += 1
         logger.info(
@@ -168,6 +195,7 @@ class PipelineServer:
                 self.state,
                 self.num_stages,
                 self.num_stages * self.chunk_cycles,
+                self.top_k,
             )
             self.counters.chunks += 1
             progressed = True
@@ -227,11 +255,15 @@ class PipelineServer:
             plen = np.ones((Bs,), np.int32)
             row_valid = np.zeros((Bs,), bool)
             max_new = np.zeros((Bs,), np.int32)
+            seeds = np.zeros((Bs,), np.int32)
+            temps = np.zeros((Bs,), np.float32)
             for i, r in enumerate(batch):
                 prompts[i, : r.prompt_len] = r.prompt
                 plen[i] = r.prompt_len
                 row_valid[i] = True
                 max_new[i] = r.max_new
+                seeds[i] = r.seed
+                temps[i] = max(r.temperature, 0.0)
                 r.row = slot * Bs + i
                 r.started_at = time.perf_counter()
                 self._rows[r.row] = r
@@ -248,8 +280,11 @@ class PipelineServer:
                 jnp.asarray(row_valid),
                 jnp.asarray(slot, jnp.int32),
                 jnp.asarray(max_new),
+                jnp.asarray(seeds),
+                jnp.asarray(temps),
                 self.num_stages,
                 self.engine.cache_dtype,
+                self.top_k,
             )
             self.counters.admissions += 1
             admitted = True
