@@ -234,18 +234,24 @@ def main():
     import jax.numpy as jnp
 
     on_tpu = jax.devices()[0].platform != "cpu"
+    # error lines must carry the same platform-qualified names the sections
+    # emit — a CPU smoke failure must never register under a chip metric
+    n7b = "decode_tok_s_llama2-7b_1chip" if on_tpu else "decode_tok_s_7b-proxy_cpu"
+    n3b = "decode_tok_s_llama3.2-3b_1chip" if on_tpu else "decode_tok_s_tiny_cpu"
+    nserve = "serve_tok_s_llama3.2-3b_1stage" if on_tpu else "serve_tok_s_tiny_cpu"
+    npallas = "pallas_prefill_speedup_s2048" if on_tpu else "pallas_prefill_speedup_cpu"
 
     try:
         bench_7b(on_tpu, jax, jnp)
     except Exception as e:  # noqa: BLE001
-        emit_error("decode_tok_s_llama2-7b_1chip", "tokens/sec", e)
+        emit_error(n7b, "tokens/sec", e)
         gc.collect()
 
     ret = None
     try:
         ret = bench_3b(on_tpu, jax, jnp)
     except Exception as e:  # noqa: BLE001
-        emit_error("decode_tok_s_llama3.2-3b_1chip", "tokens/sec", e)
+        emit_error(n3b, "tokens/sec", e)
         gc.collect()
 
     if ret is not None:
@@ -253,19 +259,16 @@ def main():
         try:
             bench_serve(on_tpu, cfg, params_np, jax, jnp)
         except Exception as e:  # noqa: BLE001
-            emit_error("serve_tok_s_llama3.2-3b_1stage", "tokens/sec", e)
+            emit_error(nserve, "tokens/sec", e)
         del params_np
         gc.collect()
     else:
-        emit_error(
-            "serve_tok_s_llama3.2-3b_1stage", "tokens/sec",
-            "not attempted: 3B section failed",
-        )
+        emit_error(nserve, "tokens/sec", "not attempted: 3B section failed")
 
     try:
         bench_pallas(on_tpu, jax, jnp)
     except Exception as e:  # noqa: BLE001
-        emit_error("pallas_prefill_speedup_s2048", "x_speedup_vs_xla", e)
+        emit_error(npallas, "x_speedup_vs_xla", e)
 
     if ret is not None:
         # headline LAST (drivers that keep one line keep this one)

@@ -281,6 +281,200 @@ def serve_admit(
 
 @functools.partial(
     jax.jit,
+    static_argnames=("cfg", "mesh", "num_stages"),
+)
+def serve_prefill_chunk(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    stage_layers: Any,
+    layer_masks: jnp.ndarray,
+    head_params: Any,  # vocab-sharded
+    state: ServeState,
+    tokens: jnp.ndarray,     # [Bs, Sc] one chunk of the (right-padded) prompts
+    positions: jnp.ndarray,  # [Bs, Sc] absolute positions; sentinel where the
+    #   row is past its prompt AND at each row's final real token (that token
+    #   is processed later via the injection path — see serve_admit_finish)
+    slot: jnp.ndarray,       # scalar int32
+    chunk_off: jnp.ndarray,  # scalar int32 cache write offset of this chunk
+    reset: jnp.ndarray,      # scalar bool — first chunk zeroes the slot rows
+    num_stages: int,
+):
+    """One bounded chunk of an admission prefill (r2 weak #4 / next-#4).
+
+    Where ``serve_admit`` traverses the whole prompt in one parked-pipeline
+    program — freezing every live stream for the full prefill — this program
+    processes ``Sc`` tokens and returns, so the host can interleave decode
+    cycles between chunks (``runtime/server.py`` drives the loop). The slot
+    stays inactive (``done``) until ``serve_admit_finish`` arms it; the
+    interleaved decode's unconditional garbage writes for the parked slot
+    land exactly at ``write_off[slot]``, which the next chunk (or the
+    injection step) overwrites before anything attends it.
+    """
+    fns = model_fns(cfg)
+    Bs, Sc = tokens.shape
+    ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+    def body(stage_layers, layer_mask, head_params, state, tokens, positions,
+             slot, chunk_off, reset):
+        layers = jax.tree.map(lambda a: a[0], stage_layers)
+        lmask = layer_mask[0]
+        hd = local_view(head_params)
+        sidx = jax.lax.axis_index(PIPE_AXIS)
+        st = jax.tree.map(
+            lambda spec, leaf: leaf[0] if spec == P(PIPE_AXIS) else leaf,
+            state_specs(state), state,
+        )
+        row0 = slot * Bs
+        k_rows = jax.lax.dynamic_slice_in_dim(st.k, row0, Bs, axis=1)
+        v_rows = jax.lax.dynamic_slice_in_dim(st.v, row0, Bs, axis=1)
+        p_rows = jax.lax.dynamic_slice_in_dim(st.kpos, row0, Bs, axis=0)
+        zero = jnp.zeros_like(k_rows)
+        sent = jnp.full_like(p_rows, POS_SENTINEL)
+        cache = KVCache(
+            k=jnp.where(reset, zero, k_rows),
+            v=jnp.where(reset, zero, v_rows),
+            pos=jnp.where(reset, sent, p_rows),
+            length=chunk_off,
+        )
+        h = sp_embed(cfg, hd, tokens, positions)
+        h, cache = ring_chain(
+            fns, cfg, layers, lmask, sidx, ring, num_stages, h, cache,
+            positions,
+        )
+
+        k_new = jax.lax.dynamic_update_slice_in_dim(st.k, cache.k, row0, axis=1)
+        v_new = jax.lax.dynamic_update_slice_in_dim(st.v, cache.v, row0, axis=1)
+        kpos_new = jax.lax.dynamic_update_slice_in_dim(
+            st.kpos, cache.pos, row0, axis=0
+        )
+        write_off = st.write_off.at[slot].set(chunk_off + Sc)
+        # accumulate the prompt into the replicated out buffer chunk by chunk
+        # (first chunk clears the previous occupant's rows)
+        out_rows = jax.lax.dynamic_slice_in_dim(st.out, row0, Bs, axis=0)
+        out_rows = jnp.where(reset, jnp.zeros_like(out_rows), out_rows)
+        out = jax.lax.dynamic_update_slice_in_dim(st.out, out_rows, row0, axis=0)
+        out = jax.lax.dynamic_update_slice(out, tokens, (row0, chunk_off))
+
+        new = st._replace(
+            k=k_new, v=v_new, kpos=kpos_new, write_off=write_off, out=out
+        )
+        return jax.tree.map(
+            lambda spec, leaf: leaf[None] if spec == P(PIPE_AXIS) else leaf,
+            state_specs(state), new,
+        )
+
+    specs = state_specs(ServeState(*([None] * len(ServeState._fields))))
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(PIPE_AXIS), P(PIPE_AXIS), head_specs(head_params), specs,
+            P(), P(), P(), P(), P(),
+        ),
+        out_specs=specs,
+        check_vma=False,
+    )(stage_layers, layer_masks, head_params, state, tokens, positions,
+      slot, chunk_off, reset)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "mesh", "num_stages")
+)
+def serve_admit_finish(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    head_params: Any,  # vocab-sharded
+    state: ServeState,
+    last_tok: jnp.ndarray,    # [Bs] each row's final real prompt token id
+    prompt_len: jnp.ndarray,  # [Bs]
+    row_valid: jnp.ndarray,   # [Bs] bool
+    slot: jnp.ndarray,        # scalar int32
+    max_new: jnp.ndarray,     # [Bs]
+    seeds: jnp.ndarray,       # [Bs] int32
+    temperature: jnp.ndarray,  # [Bs] f32
+    num_stages: int,
+):
+    """Arm a chunk-prefilled slot: park each row's final prompt token in the
+    injection path at position ``prompt_len - 1``. The slot's first
+    interleaved microstep processes it through the ring (its KV was
+    deliberately sentinel-masked during prefill, so the cache sees it exactly
+    once), and the normal completion path samples the first generated token —
+    the chunked admission needs no separate logit extraction.
+
+    Key-chain note: the stored per-row key is UNSPLIT (``key(seed)``); the
+    first commit in ``serve_chunk`` performs the first split — the same
+    chain the monolith walks, so seeded sampling stays token-exact."""
+    Bs = last_tok.shape[0]
+
+    def body(head_params, state, last_tok, prompt_len, row_valid, slot,
+             max_new, seeds, temperature):
+        hd = local_view(head_params)
+        sidx = jax.lax.axis_index(PIPE_AXIS)
+        st = jax.tree.map(
+            lambda spec, leaf: leaf[0] if spec == P(PIPE_AXIS) else leaf,
+            state_specs(state), state,
+        )
+        row0 = slot * Bs
+
+        pos_slots = jax.lax.dynamic_update_slice_in_dim(
+            st.pos_slots, prompt_len - 1, row0, axis=0
+        )
+        lengths = jax.lax.dynamic_update_slice_in_dim(
+            st.lengths, jnp.where(row_valid, prompt_len, 0), row0, axis=0
+        )
+        budget = jax.lax.dynamic_update_slice_in_dim(
+            st.budget, jnp.where(row_valid, prompt_len + max_new, 0), row0,
+            axis=0,
+        )
+        done = jax.lax.dynamic_update_slice_in_dim(
+            st.done, ~row_valid | (max_new < 1), row0, axis=0
+        )
+        inj = sp_embed(cfg, hd, last_tok[:, None], (prompt_len - 1)[:, None])
+        inject = jax.lax.dynamic_update_slice_in_dim(
+            st.inject, inj.astype(st.inject.dtype), row0, axis=0
+        )
+        inject_pending = jax.lax.dynamic_update_slice_in_dim(
+            st.inject_pending, row_valid & (max_new >= 1), row0, axis=0
+        )
+        row_keys = jax.vmap(
+            lambda s: jax.random.key_data(jax.random.key(s))
+        )(seeds)
+        rng = jax.lax.dynamic_update_slice_in_dim(
+            st.rng, row_keys, row0, axis=0
+        )
+        temp = jax.lax.dynamic_update_slice_in_dim(
+            st.temp, jnp.where(row_valid, temperature, 0.0), row0, axis=0
+        )
+        # same stale-parked-block defense as serve_admit
+        next_served = jnp.mod(st.m - sidx, num_stages)
+        h_valid = jnp.where(next_served == slot, False, st.h_valid)
+
+        new = st._replace(
+            pos_slots=pos_slots, lengths=lengths, budget=budget, done=done,
+            inject=inject, inject_pending=inject_pending, rng=rng, temp=temp,
+            h_valid=h_valid,
+        )
+        return jax.tree.map(
+            lambda spec, leaf: leaf[None] if spec == P(PIPE_AXIS) else leaf,
+            state_specs(state), new,
+        )
+
+    specs = state_specs(ServeState(*([None] * len(ServeState._fields))))
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            head_specs(head_params), specs,
+            P(), P(), P(), P(), P(), P(), P(),
+        ),
+        out_specs=specs,
+        check_vma=False,
+    )(head_params, state, last_tok, prompt_len, row_valid, slot, max_new,
+      seeds, temperature)
+
+
+@functools.partial(
+    jax.jit,
     static_argnames=("cfg", "mesh", "num_stages", "n_micro", "top_k"),
 )
 def serve_chunk(

@@ -249,6 +249,7 @@ class PipelineEngine:
         batch_per_slot: int = 1,
         chunk_cycles: int = 1,
         top_k: int = 0,
+        prefill_chunk: Optional[int] = None,
     ):
         """Build a continuous-batching server over this engine's sharded
         arrays (≙ the reference's persistent ``run_worker_loop`` daemon,
@@ -261,6 +262,7 @@ class PipelineEngine:
             batch_per_slot=batch_per_slot,
             chunk_cycles=chunk_cycles,
             top_k=top_k,
+            prefill_chunk=prefill_chunk,
         )
 
     def _shared_server(self, prompt_len: int, max_new: int):

@@ -148,3 +148,73 @@ def test_server_counters_and_logs(setup, caplog):
     assert snap["requests_completed"] == 1
     assert snap["tokens_generated"] == len(req.tokens)
     assert any("complete id=0" in r.getMessage() for r in caplog.records)
+
+
+def test_chunked_admission_no_stall(setup):
+    """r2 next-#4 acceptance: while a long prompt is being admitted in
+    bounded prefill chunks, an in-flight request keeps producing tokens
+    (monotonically growing ``req.tokens``), and both outputs stay
+    token-exact — including a seeded sampled request, whose key chain runs
+    through the chunked path's injection-based first token."""
+    params, eng = setup
+    srv = eng.serve(capacity=128, prefill_chunk=16)
+    rng = np.random.default_rng(7)
+
+    pa = rng.integers(1, CFG.vocab_size, 5).astype(np.int32)
+    ra = srv.submit(pa, max_new_tokens=40)
+    srv.step()
+    srv.step()
+    n_before = len(ra.tokens)
+
+    # bucket 64 > prefill_chunk 16 → 4 chunks, one decode cycle interleaved
+    # after each
+    pb = rng.integers(1, CFG.vocab_size, 50).astype(np.int32)
+    rb = srv.submit(pb, max_new_tokens=10, temperature=0.8, seed=13)
+    srv.step()  # the admitting step
+    n_during = len(ra.tokens)
+    assert n_during - n_before >= 4, (
+        "in-flight request stalled during chunked admission"
+    )
+
+    srv.run_until_idle()
+    assert ra.tokens == oracle_tokens(params, pa, 40)
+    want = generate(
+        CFG, params, pb[None], 10, temperature=0.8, seed=13,
+        cache_dtype=jnp.float32,
+    )
+    assert rb.tokens == [
+        int(x) for x in want.tokens[0, len(pb): int(want.lengths[0])]
+    ]
+
+
+def test_chunked_admission_edge_lengths(setup):
+    """Chunked path edges: a 1-token prompt (everything rides the injection
+    step) and a prompt exactly at a chunk boundary."""
+    params, eng = setup
+    srv = eng.serve(capacity=128, prefill_chunk=16)
+    rng = np.random.default_rng(8)
+    p1 = np.array([7], np.int32)
+    p2 = rng.integers(1, CFG.vocab_size, 32).astype(np.int32)
+    r1 = srv.submit(p1, 5)
+    r2 = srv.submit(p2, 8)
+    srv.run_until_idle()
+    assert r1.tokens == oracle_tokens(params, p1, 5)
+    assert r2.tokens == oracle_tokens(params, p2, 8)
+
+
+def test_mixed_bucket_requests_not_coadmitted(setup):
+    """Requests whose prompt buckets differ must not share an admission
+    batch: submit() validates capacity against each request's OWN bucket,
+    and admitting a short prompt under a larger batch bucket would start its
+    decode writes at the larger offset and overflow the cache silently.
+    Both must still complete token-exact (in separate admissions)."""
+    params, eng = setup
+    srv = eng.serve(capacity=64, batch_per_slot=2)
+    rng = np.random.default_rng(9)
+    p_short = rng.integers(1, CFG.vocab_size, 3).astype(np.int32)   # bucket 8
+    p_long = rng.integers(1, CFG.vocab_size, 12).astype(np.int32)   # bucket 16
+    r1 = srv.submit(p_short, max_new_tokens=48)  # 8 + 48 = 56 <= 64 (own bucket)
+    r2 = srv.submit(p_long, max_new_tokens=8)
+    srv.run_until_idle()
+    assert r1.tokens == oracle_tokens(params, p_short, 48)
+    assert r2.tokens == oracle_tokens(params, p_long, 8)
