@@ -94,22 +94,87 @@ def cmd_generate(args) -> int:
     return 0
 
 
+def _serve_control(eng, srv, line: str, args):
+    """Daemon control lines (≙ the reference's hot config push checked every
+    loop iteration, ``/root/reference/utils/node_worker.py:445-474`` — there
+    the master re-sends a JSON config over ZMQ; here the operator types a
+    control line into the running daemon):
+
+    - ``:placement 0:6,6:32`` — drain in-flight requests, hot-apply the new
+      layer→stage mapping, rebuild the continuous-batching server on it
+    - ``:placement 4``        — balanced split over 4 stages
+    - ``:counters``           — print the running counters
+
+    Returns the (possibly new) server.
+    """
+    from .parallel.placement import PlacementSpec
+
+    parts = line.split(None, 1)
+    cmd = parts[0]
+    if cmd == ":counters":
+        print(json.dumps(srv.counters.snapshot()), file=sys.stderr)
+        return srv
+    if cmd == ":placement":
+        if len(parts) < 2:
+            print("usage: :placement 0:6,6:32  |  :placement N", file=sys.stderr)
+            return srv
+        num_layers = eng.cfg.num_hidden_layers
+        # in-flight requests finish on the old arrays, then swap; any failure
+        # (bad ranges, more stages than devices) keeps the daemon serving on
+        # the old placement — apply_placement only mutates on success
+        try:
+            if ":" in parts[1]:
+                spec = PlacementSpec.from_ranges(
+                    _parse_ranges(parts[1]), num_layers
+                )
+            else:
+                spec = PlacementSpec.balanced(num_layers, int(parts[1]))
+            srv.run_until_idle()
+            counters = srv.counters
+            eng.apply_placement(spec)
+        except (ValueError, KeyError) as e:
+            print(f"bad placement: {e}", file=sys.stderr)
+            return srv
+        srv = eng.serve(
+            capacity=args.capacity,
+            batch_per_slot=args.batch_per_slot,
+            prefill_chunk=args.prefill_chunk,
+        )
+        srv.counters = counters  # session totals survive the swap
+        print(
+            f"placement applied: {list(spec.stages)} over {eng.mesh.shape}",
+            file=sys.stderr,
+        )
+        return srv
+    print(f"unknown control line {cmd!r} (try :placement, :counters)",
+          file=sys.stderr)
+    return srv
+
+
 def cmd_serve(args) -> int:
     """Interactive persistent daemon: one prompt per stdin line, streamed
-    completion per line (≙ the reference's forever-spinning worker loop)."""
+    completion per line (≙ the reference's forever-spinning worker loop).
+    Lines starting with ``:`` are operator control commands — see
+    ``_serve_control`` (hot repartition without restarting the daemon)."""
     eng = _engine(args)
     srv = eng.serve(
-        capacity=args.capacity, batch_per_slot=args.batch_per_slot
+        capacity=args.capacity,
+        batch_per_slot=args.batch_per_slot,
+        prefill_chunk=args.prefill_chunk,
     )
     print(
         f"serving {eng.cfg.model_type} over {eng.mesh.shape} "
-        f"(capacity={args.capacity}); enter a prompt, ^D to exit",
+        f"(capacity={args.capacity}); enter a prompt, ^D to exit; "
+        f":placement <ranges|N> re-shards live",
         file=sys.stderr,
     )
     tok = eng._require_tokenizer()
     for line in sys.stdin:
         prompt = line.rstrip("\n")
         if not prompt:
+            continue
+        if prompt.startswith(":"):
+            srv = _serve_control(eng, srv, prompt, args)
             continue
         ids = np.asarray(tok(prompt)["input_ids"], np.int32)
         req = srv.submit(ids, args.max_new)
@@ -245,6 +310,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--ranges")
     s.add_argument("--capacity", type=int, default=1024)
     s.add_argument("--batch-per-slot", type=int, default=1, dest="batch_per_slot")
+    s.add_argument(
+        "--prefill-chunk", type=int, default=None, dest="prefill_chunk",
+        help="prefill prompts longer than this in bounded chunks so live "
+        "streams keep producing during admission (power of two)",
+    )
     s.add_argument("--dtype", default="bf16")
     s.set_defaults(fn=cmd_serve)
 

@@ -52,13 +52,39 @@ from .convert import (
 _WEIGHT_SUFFIXES = (".bin", ".safetensors", ".pth", ".pt", ".gguf")
 
 
+# numpy's npz format cannot round-trip ml_dtypes extension types (bf16 etc.
+# are written as raw void and cannot be cast back on load), so such arrays
+# are stored as same-width integer views plus a `<name>__dtype` tag.
+_DTYPE_TAG = "__dtype"
+_INT_VIEW = {1: np.uint8, 2: np.uint16, 4: np.uint32}
+
+
 def _save_npz(path: str, arrays: dict[str, Any]) -> None:
-    np.savez(path, **{k: np.asarray(v) for k, v in arrays.items()})
+    out: dict[str, np.ndarray] = {}
+    for k, v in arrays.items():
+        a = np.asarray(v)
+        if a.dtype.kind == "V":  # ml_dtypes extension types report kind 'V'
+            out[k] = a.view(_INT_VIEW[a.dtype.itemsize])
+            out[k + _DTYPE_TAG] = np.asarray(a.dtype.name)
+        else:
+            out[k] = a
+    np.savez(path, **out)
 
 
 def _load_npz(path: str, dtype) -> dict[str, jnp.ndarray]:
+    import ml_dtypes
+
     with np.load(path) as z:
-        return {k: jnp.asarray(z[k], dtype) for k in z.files}
+        res = {}
+        for k in z.files:
+            if k.endswith(_DTYPE_TAG):
+                continue
+            a = z[k]
+            tag = k + _DTYPE_TAG
+            if tag in z.files:
+                a = a.view(np.dtype(getattr(ml_dtypes, str(z[tag]))))
+            res[k] = jnp.asarray(a, dtype)
+        return res
 
 
 def save_shards(

@@ -179,3 +179,64 @@ def test_convert_requires_weights(tmp_path):
     )
     with pytest.raises(FileNotFoundError):
         cli.main(["convert", str(src), str(tmp_path / "out")])
+
+
+def test_serve_placement_control_line(shards, capsys, monkeypatch):
+    """r2 next-#9: the daemon hot-repartitions on a ``:placement`` control
+    line (≙ the reference's mid-service config push, ``node_worker.py:
+    445-474``). The same prompt before and after the swap must stream the
+    same completion — placement is an execution detail — and session
+    counters survive the swap."""
+    from llm_sharding_tpu.runtime import engine as engine_mod
+
+    monkeypatch.setattr(
+        engine_mod.PipelineEngine,
+        "_require_tokenizer",
+        lambda self: IdTokenizer(),
+    )
+    monkeypatch.setattr(
+        "sys.stdin",
+        io.StringIO("same prompt\n:placement 0:3,3:4,4:8\nsame prompt\n"),
+    )
+    rc = cli.main(
+        [
+            "serve", shards, "--max-new", "4", "--stages", "4",
+            "--capacity", "64", "--dtype", "f32",
+        ]
+    )
+    assert rc == 0
+    captured = capsys.readouterr()
+    lines = [l for l in captured.out.splitlines() if l.strip()]
+    assert len(lines) == 2
+    assert lines[0] == lines[1], "repartition changed the served output"
+    assert "placement applied: [(0, 3), (3, 4), (4, 8)]" in captured.err
+    assert '"requests_completed": 2' in captured.err
+
+
+def test_serve_control_line_errors(shards, capsys, monkeypatch):
+    from llm_sharding_tpu.runtime import engine as engine_mod
+
+    monkeypatch.setattr(
+        engine_mod.PipelineEngine,
+        "_require_tokenizer",
+        lambda self: IdTokenizer(),
+    )
+    monkeypatch.setattr(
+        "sys.stdin",
+        # bad ranges; more stages than devices (16 > 8); unknown command —
+        # the daemon must survive all three and still serve the final prompt
+        io.StringIO(":placement 0:3\n:placement 16\n:bogus\n:counters\nstill up\n"),
+    )
+    rc = cli.main(
+        [
+            "serve", shards, "--max-new", "4", "--stages", "4",
+            "--capacity", "64", "--dtype", "f32",
+        ]
+    )
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert captured.err.count("bad placement") == 2
+    assert "unknown control line" in captured.err
+    assert '"requests_submitted": 0' in captured.err
+    assert len([l for l in captured.out.splitlines() if l.strip()]) == 1
+    assert '"requests_completed": 1' in captured.err

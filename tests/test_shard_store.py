@@ -116,3 +116,29 @@ def test_streaming_save_matches_hf_layout(tmp_path):
         np.testing.assert_array_equal(
             np.asarray(loaded["layers"][k]), np.asarray(direct["layers"][k])
         )
+
+
+def test_bf16_store_round_trip(tmp_path):
+    """npz cannot natively round-trip ml_dtypes bf16 (saved as raw void, no
+    cast back) — the store writes integer views + a dtype tag instead. A
+    bf16-saved store must load back bitwise in bf16 and upcast to f32."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from llm_sharding_tpu.models import llama
+    from llm_sharding_tpu.models.config import tiny_llama
+    from llm_sharding_tpu.utils import shard_store
+
+    cfg = tiny_llama(num_hidden_layers=2)
+    params = llama.init_params(cfg, jax.random.key(1), dtype=jnp.bfloat16)
+    out = str(tmp_path / "bf16_store")
+    shard_store.save_shards(cfg, params, out)
+
+    cfg2, loaded = shard_store.load_full(out, dtype=jnp.bfloat16)
+    assert cfg2 == cfg
+    np.testing.assert_array_equal(
+        np.asarray(loaded["embed"]).view(np.uint16),
+        np.asarray(params["embed"]).view(np.uint16),
+    )
+    _, as_f32 = shard_store.load_full(out, dtype=jnp.float32)
+    assert as_f32["layers"]["wq"].dtype == jnp.float32
