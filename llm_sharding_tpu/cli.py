@@ -135,17 +135,28 @@ def _serve_control(eng, srv, line: str, args):
         except (ValueError, KeyError) as e:
             print(f"bad placement: {e}", file=sys.stderr)
             return srv
-        srv = eng.serve(
-            capacity=args.capacity,
-            batch_per_slot=args.batch_per_slot,
-            prefill_chunk=args.prefill_chunk,
-        )
-        srv.counters = counters  # session totals survive the swap
+        try:
+            new_srv = eng.serve(
+                capacity=args.capacity,
+                batch_per_slot=args.batch_per_slot,
+                prefill_chunk=args.prefill_chunk,
+            )
+        except Exception as e:  # noqa: BLE001 — keep the daemon alive
+            # placement already swapped but the new server failed to build
+            # (e.g. state allocation OOM at the denser packing); the old
+            # server object still holds the previous arrays and keeps serving
+            print(
+                f"placement applied but server rebuild failed ({e}); "
+                "still serving on the previous placement's server",
+                file=sys.stderr,
+            )
+            return srv
+        new_srv.counters = counters  # session totals survive the swap
         print(
             f"placement applied: {list(spec.stages)} over {eng.mesh.shape}",
             file=sys.stderr,
         )
-        return srv
+        return new_srv
     print(f"unknown control line {cmd!r} (try :placement, :counters)",
           file=sys.stderr)
     return srv

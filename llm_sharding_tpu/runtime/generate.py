@@ -198,6 +198,40 @@ MIN_SEGMENT_CAPACITY = 256
 SEGMENT_GROWTH = 4
 
 
+def _validate_totals(cfg: ModelConfig, S: int, max_new_tokens: int, capacity: int):
+    total = S + max_new_tokens
+    if total > capacity:
+        raise ValueError(
+            f"prompt ({S}) + max_new_tokens ({max_new_tokens}) exceeds KV cache "
+            f"capacity ({capacity}); raise capacity or shorten the request"
+        )
+    if total > cfg.max_position_embeddings:
+        raise ValueError(
+            f"requested {total} positions > max_position_embeddings "
+            f"({cfg.max_position_embeddings})"
+        )
+
+
+def _run_decode_segments(
+    cfg, params, state, S, capacity, max_new_tokens, temperature, top_k, fwd
+):
+    """Shared decode tail: walk the segment-capacity ladder until the budget
+    is spent or every row stopped (used by ``generate`` and
+    ``decode_from_cache`` so the ladder/early-exit logic exists once)."""
+    for cap in _segment_capacities(S + 1, capacity):
+        # cache write offset after n decode steps is S + n; stop this segment
+        # before it would write past the segment capacity
+        n_limit = min(max_new_tokens, cap - S)
+        state = _decode_segment_jit(
+            cfg, params, state, n_limit, cap, temperature, top_k, fwd
+        )
+        if int(state["n"]) >= max_new_tokens or bool(np.all(state["done"])):
+            break
+    return GenerateResult(
+        np.asarray(state["out"]), np.asarray(state["lengths"]), state["cache"]
+    )
+
+
 def _segment_capacities(start_need: int, capacity: int) -> list[int]:
     """Capacity ladder covering [start_need, capacity]. A segment boundary is
     only worth its slice/write-back + dispatch cost when capacity at least
@@ -241,16 +275,7 @@ def generate(
 
     total = S + max_new_tokens
     capacity = capacity or total
-    if total > capacity:
-        raise ValueError(
-            f"prompt ({S}) + max_new_tokens ({max_new_tokens}) exceeds KV cache "
-            f"capacity ({capacity}); raise capacity or shorten the request"
-        )
-    if total > cfg.max_position_embeddings:
-        raise ValueError(
-            f"requested {total} positions > max_position_embeddings "
-            f"({cfg.max_position_embeddings})"
-        )
+    _validate_totals(cfg, S, max_new_tokens, capacity)
 
     # Segmented decode (VERDICT r2 weak #3): the cache is allocated at full
     # capacity ONCE, but each decode segment's compiled program slices a
@@ -278,17 +303,88 @@ def generate(
         cfg, params, prompt_ids, prompt_len, cache, jax.random.key(seed),
         max_new_tokens, caps[0], temperature, top_k, fwd,
     )
-    for cap in caps:
-        # cache write offset after n decode steps is S + n; stop this segment
-        # before it would write past the segment capacity
-        n_limit = min(max_new_tokens, cap - S)
-        state = _decode_segment_jit(
-            cfg, params, state, n_limit, cap, temperature, top_k, fwd
+    return _run_decode_segments(
+        cfg, params, state, S, capacity, max_new_tokens, temperature, top_k,
+        fwd,
+    )
+
+
+def decode_from_cache(
+    cfg: ModelConfig,
+    params: Any,
+    prompt_ids: np.ndarray | jnp.ndarray,  # [B, S] right-padded or [S]
+    last_logits: np.ndarray | jnp.ndarray,  # [B, V] logits of last real token
+    cache: KVCache,  # prefilled: slot index == sequence index, length == S
+    max_new_tokens: int = 128,
+    *,
+    prompt_len: Optional[np.ndarray] = None,
+    capacity: Optional[int] = None,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    seed: int = 0,
+    donate_cache: bool = True,
+) -> GenerateResult:
+    """Continue decoding from an externally produced prefill state — the
+    handoff point for context-parallel prefill (``parallel/context.py``):
+    ring attention fills the cache sequence-parallel, this runs the same
+    compiled decode loop the monolith uses, with the monolith's key chain
+    (one split for the first token, one per step), so the combined path is
+    token-exact vs ``generate``.
+
+    ``cache`` is CONSUMED by default (the decode loop donates its buffers —
+    on TPU the caller's arrays are invalidated). Pass ``donate_cache=False``
+    to decode from one prefill several times (e.g. multiple sampled
+    completions); it copies the cache first."""
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    if prompt_ids.ndim == 1:
+        prompt_ids = prompt_ids[None]
+    B, S = prompt_ids.shape
+    if prompt_len is None:
+        prompt_len = jnp.full((B,), S, jnp.int32)
+    else:
+        prompt_len = jnp.asarray(prompt_len, jnp.int32)
+
+    total = S + max_new_tokens
+    capacity = max(capacity or total, cache.capacity)
+    _validate_totals(cfg, S, max_new_tokens, capacity)
+    if cache.capacity < capacity:  # pad the prefilled cache up to capacity
+        pad = capacity - cache.capacity
+        cache = KVCache(
+            k=jnp.pad(cache.k, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
+            v=jnp.pad(cache.v, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
+            pos=jnp.pad(
+                cache.pos, ((0, 0), (0, pad)),
+                constant_values=np.int32(POS_SENTINEL),
+            ),
+            length=cache.length,
         )
-        if int(state["n"]) >= max_new_tokens or bool(np.all(state["done"])):
-            break
-    return GenerateResult(
-        np.asarray(state["out"]), np.asarray(state["lengths"]), state["cache"]
+    elif not donate_cache:
+        # no padding copy was made — copy so donation can't invalidate the
+        # caller's prefill
+        cache = jax.tree.map(jnp.copy, cache)
+
+    fwd = forward_fn_for(cfg)
+    temperature, top_k = float(temperature), int(top_k)
+    key = jax.random.key(seed)
+    key, sub = jax.random.split(key)
+    tok0 = _sample(jnp.asarray(last_logits, jnp.float32), sub, temperature, top_k)
+
+    out = jnp.zeros((B, total), jnp.int32)
+    out = jax.lax.dynamic_update_slice(out, prompt_ids, (0, 0))
+    out = out.at[jnp.arange(B), prompt_len].set(tok0)
+    state = dict(
+        out=out,
+        cache=cache,
+        tok=tok0,
+        pos=prompt_len,
+        done=_is_stop(cfg, tok0),
+        n=jnp.ones((), jnp.int32),
+        key=key,
+        lengths=prompt_len + 1,
+    )
+    return _run_decode_segments(
+        cfg, params, state, S, capacity, max_new_tokens, temperature, top_k,
+        fwd,
     )
 
 

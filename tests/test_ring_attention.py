@@ -128,3 +128,49 @@ def test_indivisible_length_rejected():
     mesh = context_mesh(8)
     with pytest.raises(ValueError, match="divisible"):
         context_prefill(CFG, mesh, params, np.zeros((1, 30), np.int32))
+
+
+def test_context_prefill_to_decode_token_exact():
+    """r2 next-#6 acceptance: ring-attention prefill emits a decode cache and
+    greedy decode from it matches the monolithic oracle token-exact — the
+    long-context path is a serving feature, not a scorer demo."""
+    from llm_sharding_tpu.parallel.context import context_generate
+    from llm_sharding_tpu.runtime.generate import generate
+
+    params = llama.init_params(CFG, jax.random.key(2), dtype=jnp.float32)
+    rng = np.random.default_rng(5)
+    # padded batch: rows shorter than the (divisible) padded width
+    ids = rng.integers(0, CFG.vocab_size, (2, 32)).astype(np.int32)
+    plen = np.array([29, 32], np.int32)
+
+    mesh = context_mesh(8)
+    got = context_generate(
+        CFG, mesh, params, ids, 12, prompt_len=plen, cache_dtype=jnp.float32
+    )
+    want = generate(
+        CFG, params, ids, 12, prompt_len=plen, cache_dtype=jnp.float32
+    )
+    np.testing.assert_array_equal(got.tokens, want.tokens)
+    np.testing.assert_array_equal(got.lengths, want.lengths)
+
+
+def test_context_prefill_to_decode_sampled():
+    """Seeded sampling through the handoff matches the monolith (same key
+    chain: one split for the first token, one per decode step)."""
+    from llm_sharding_tpu.parallel.context import context_generate
+    from llm_sharding_tpu.runtime.generate import generate
+
+    params = llama.init_params(CFG, jax.random.key(2), dtype=jnp.float32)
+    rng = np.random.default_rng(6)
+    ids = rng.integers(0, CFG.vocab_size, (1, 16)).astype(np.int32)
+
+    mesh = context_mesh(4)
+    got = context_generate(
+        CFG, mesh, params, ids, 10, temperature=0.8, top_k=9, seed=3,
+        cache_dtype=jnp.float32,
+    )
+    want = generate(
+        CFG, params, ids, 10, temperature=0.8, top_k=9, seed=3,
+        cache_dtype=jnp.float32,
+    )
+    np.testing.assert_array_equal(got.tokens, want.tokens)
