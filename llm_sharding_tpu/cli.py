@@ -119,6 +119,7 @@ def _serve_control(eng, srv, line: str, args):
             print("usage: :placement 0:6,6:32  |  :placement N", file=sys.stderr)
             return srv
         num_layers = eng.cfg.num_hidden_layers
+        old_spec = eng.placement
         # in-flight requests finish on the old arrays, then swap; any failure
         # (bad ranges, more stages than devices) keeps the daemon serving on
         # the old placement — apply_placement only mutates on success
@@ -135,25 +136,32 @@ def _serve_control(eng, srv, line: str, args):
         except (ValueError, KeyError) as e:
             print(f"bad placement: {e}", file=sys.stderr)
             return srv
-        try:
-            new_srv = eng.serve(
+        def build():
+            return eng.serve(
                 capacity=args.capacity,
                 batch_per_slot=args.batch_per_slot,
                 prefill_chunk=args.prefill_chunk,
             )
+
+        try:
+            new_srv = build()
+            applied = spec
         except Exception as e:  # noqa: BLE001 — keep the daemon alive
-            # placement already swapped but the new server failed to build
-            # (e.g. state allocation OOM at the denser packing); the old
-            # server object still holds the previous arrays and keeps serving
+            # The new placement's server failed to build (e.g. state
+            # allocation OOM at the denser packing). The old server object
+            # is unusable too — it reads the engine's (now swapped) arrays
+            # live — so ROLL BACK the placement and rebuild on it.
+            eng.apply_placement(old_spec)
+            new_srv = build()
+            applied = old_spec
             print(
-                f"placement applied but server rebuild failed ({e}); "
-                "still serving on the previous placement's server",
+                f"placement rebuild failed ({e}); rolled back to "
+                f"{list(old_spec.stages)}",
                 file=sys.stderr,
             )
-            return srv
         new_srv.counters = counters  # session totals survive the swap
         print(
-            f"placement applied: {list(spec.stages)} over {eng.mesh.shape}",
+            f"placement applied: {list(applied.stages)} over {eng.mesh.shape}",
             file=sys.stderr,
         )
         return new_srv

@@ -177,7 +177,11 @@ def copy_tokenizer_files(src_dir: str, out_dir: str) -> None:
         p = os.path.join(src_dir, name)
         if not os.path.isfile(p):
             continue
-        if name.endswith(_WEIGHT_SUFFIXES) or name == "config.json":
+        if (
+            name.endswith(_WEIGHT_SUFFIXES)
+            or name.endswith(".index.json")  # multi-shard weight index
+            or name == "config.json"
+        ):
             continue
         shutil.copy2(p, os.path.join(out_dir, name))
 

@@ -240,3 +240,42 @@ def test_serve_control_line_errors(shards, capsys, monkeypatch):
     assert '"requests_submitted": 0' in captured.err
     assert len([l for l in captured.out.splitlines() if l.strip()]) == 1
     assert '"requests_completed": 1' in captured.err
+
+
+def test_serve_placement_rollback_on_rebuild_failure(shards, capsys, monkeypatch):
+    """If the new placement's server fails to build, the daemon rolls the
+    placement back and rebuilds on it (the old server object reads the
+    engine's arrays live, so keeping it after a swap would mix meshes)."""
+    from llm_sharding_tpu.runtime import engine as engine_mod
+
+    monkeypatch.setattr(
+        engine_mod.PipelineEngine,
+        "_require_tokenizer",
+        lambda self: IdTokenizer(),
+    )
+    orig = engine_mod.PipelineEngine.serve
+    calls = {"n": 0}
+
+    def flaky(self, **kw):
+        calls["n"] += 1
+        if calls["n"] == 2:  # 1st: daemon startup; 2nd: rebuild after swap
+            raise RuntimeError("synthetic allocation failure")
+        return orig(self, **kw)
+
+    monkeypatch.setattr(engine_mod.PipelineEngine, "serve", flaky)
+    monkeypatch.setattr(
+        "sys.stdin",
+        io.StringIO("same prompt\n:placement 2\nsame prompt\n"),
+    )
+    rc = cli.main(
+        [
+            "serve", shards, "--max-new", "4", "--stages", "4",
+            "--capacity", "64", "--dtype", "f32",
+        ]
+    )
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert "rolled back to [(0, 2), (2, 4), (4, 6), (6, 8)]" in captured.err
+    lines = [l for l in captured.out.splitlines() if l.strip()]
+    assert len(lines) == 2 and lines[0] == lines[1]
+    assert '"requests_completed": 2' in captured.err

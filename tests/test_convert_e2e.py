@@ -1,0 +1,116 @@
+"""Checkpoint conversion proof against a genuine multi-shard safetensors
+checkpoint (r2 next-#8): an HF ``save_pretrained`` directory with several
+``model-0000x-of-0000N.safetensors`` files, the ``.index.json``, and real
+tokenizer files — converted, loaded through ``PipelineEngine.from_shards``
+(tokenizer round-trip included), and served; the output must match HF
+``model.generate`` exactly (≙ the reference's ModelSharder consuming real
+checkpoints, ``/root/reference/utils/model_sharder.py:27-46``,
+``inference.py:20-45``; no network in this environment, so the checkpoint is
+built locally at tiny scale with the real HF serialization path).
+"""
+
+import os
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from llm_sharding_tpu.utils.shard_store import convert_hf_checkpoint
+
+
+@pytest.fixture(scope="module")
+def hf_checkpoint(tmp_path_factory):
+    """A real multi-shard HF checkpoint dir: LlamaForCausalLM.save_pretrained
+    with a shard size small enough to force several safetensors files, plus a
+    PreTrainedTokenizerFast (WordLevel over characters)."""
+    import torch
+    from tokenizers import Tokenizer, models, pre_tokenizers
+    from transformers import (
+        LlamaConfig,
+        LlamaForCausalLM,
+        PreTrainedTokenizerFast,
+    )
+
+    torch.manual_seed(11)
+    vocab = {c: i + 3 for i, c in enumerate("abcdefghijklmnopqrstuvwxyz ")}
+    vocab.update({"[UNK]": 0, "[BOS]": 1, "[EOS]": 2})
+    hf_cfg = LlamaConfig(
+        vocab_size=len(vocab),
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=8,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        tie_word_embeddings=False,
+        bos_token_id=1,
+        eos_token_id=2,
+    )
+    model = LlamaForCausalLM(hf_cfg).eval()
+
+    t = Tokenizer(models.WordLevel(vocab, unk_token="[UNK]"))
+    t.pre_tokenizer = pre_tokenizers.Split("", "isolated")
+    tokenizer = PreTrainedTokenizerFast(
+        tokenizer_object=t, unk_token="[UNK]", bos_token="[BOS]",
+        eos_token="[EOS]",
+    )
+
+    d = str(tmp_path_factory.mktemp("hf") / "tiny-llama-multishard")
+    model.save_pretrained(d, max_shard_size="100KB")
+    tokenizer.save_pretrained(d)
+    return d, model, tokenizer
+
+
+def test_checkpoint_is_genuinely_multishard(hf_checkpoint):
+    d, _, _ = hf_checkpoint
+    st = [f for f in os.listdir(d) if f.endswith(".safetensors")]
+    assert len(st) > 1, f"expected a multi-shard checkpoint, got {st}"
+    assert "model.safetensors.index.json" in os.listdir(d)
+
+
+def test_convert_load_serve_matches_hf(hf_checkpoint, tmp_path):
+    """convert → from_shards (tokenizer round-trip) → pipelined generate_text
+    == HF model.generate, greedy, text-for-text."""
+    import torch
+
+    from llm_sharding_tpu.runtime.engine import PipelineEngine
+
+    d, model, tokenizer = hf_checkpoint
+    out = str(tmp_path / "store")
+    cfg = convert_hf_checkpoint(d, out, dtype=jnp.float32)
+    assert cfg.num_hidden_layers == 8
+
+    # weight index json is build metadata, not a tokenizer file
+    assert "model.safetensors.index.json" not in os.listdir(out)
+
+    eng = PipelineEngine.from_shards(out, num_stages=4, dtype=jnp.float32)
+    assert eng.tokenizer is not None, "tokenizer files did not round-trip"
+
+    prompt = "the quick brown fox"
+    max_new = 16
+
+    ids = torch.tensor([tokenizer(prompt)["input_ids"]])
+    with torch.no_grad():
+        hf_out = model.generate(
+            ids, max_new_tokens=max_new, do_sample=False,
+            pad_token_id=model.config.eos_token_id,
+        )
+    want = tokenizer.decode(
+        hf_out[0, ids.shape[1]:], skip_special_tokens=True
+    )
+
+    got = eng.generate_text(prompt, max_new)
+    assert got == want, (got, want)
+
+
+def test_convert_bf16_store_servable(hf_checkpoint, tmp_path):
+    """The default bf16 conversion produces a loadable, servable store (the
+    dtype the operator CLI writes)."""
+    from llm_sharding_tpu.runtime.engine import PipelineEngine
+
+    d, _, _ = hf_checkpoint
+    out = str(tmp_path / "store_bf16")
+    convert_hf_checkpoint(d, out, dtype=jnp.bfloat16)
+    eng = PipelineEngine.from_shards(out, num_stages=2, dtype=jnp.bfloat16)
+    text = eng.generate_text("hello world", 8)
+    assert isinstance(text, str)
