@@ -151,8 +151,21 @@ def _serve_control(eng, srv, line: str, args):
             # allocation OOM at the denser packing). The old server object
             # is unusable too — it reads the engine's (now swapped) arrays
             # live — so ROLL BACK the placement and rebuild on it.
-            eng.apply_placement(old_spec)
-            new_srv = build()
+            try:
+                eng.apply_placement(old_spec)
+                new_srv = build()
+            except Exception as e2:  # noqa: BLE001
+                # rollback failed too: no valid server exists on either
+                # placement — print the session totals and stop cleanly
+                # instead of crashing on the next prompt
+                print(json.dumps(counters.snapshot()), file=sys.stderr)
+                print(
+                    f"placement rebuild failed ({e}) and rollback to "
+                    f"{list(old_spec.stages)} also failed ({e2}); daemon "
+                    "state is unrecoverable, exiting",
+                    file=sys.stderr,
+                )
+                raise SystemExit(1)
             applied = old_spec
             print(
                 f"placement rebuild failed ({e}); rolled back to "
@@ -208,6 +221,147 @@ def cmd_serve(args) -> int:
         print(flush=True)
     print(json.dumps(srv.counters.snapshot()), file=sys.stderr)
     return 0
+
+
+def cmd_worker(args) -> int:
+    """One multi-controller process (≙ ``start_node.py`` — one OS process per
+    node, ``/root/reference/start_node.py:6-20``): joins the cluster, builds
+    the engine over the GLOBAL mesh, and runs the same SPMD program as every
+    other worker. Process 0 speaks for the job."""
+    import os
+
+    if args.local_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.local_devices}"
+        )
+    # must precede ANY backend use (see parallel/distributed.py)
+    from .parallel.distributed import initialize_multihost
+
+    initialize_multihost(args.coordinator, args.processes, args.process_id)
+    import jax
+
+    print(
+        f"[worker {args.process_id}] joined: {jax.process_count()} processes, "
+        f"{jax.device_count()} global devices",
+        file=sys.stderr,
+    )
+    eng = _engine(args)
+    text = eng.generate_text(args.prompt, args.max_new)
+    if args.process_id == 0:
+        print(text)
+    return 0
+
+
+def cmd_launch(args) -> int:
+    """Spawn N worker processes on this host and wait (≙ ``run_this.sh:8-17``
+    spawning per-node ``start_node.py`` daemons with per-node logs). Each
+    worker joins the jax.distributed cluster and runs the same pipelined
+    program over the global mesh; worker 0's completion goes to stdout, and
+    every worker's output is kept in ``worker_<i>.log`` (≙ ``node_<port>.log``).
+
+    On a real multi-host pod, run ``worker`` directly — one per host, with
+    ``--coordinator host0:port``. ``--platform cpu`` simulates the pod on one
+    machine with virtual CPU devices."""
+    import contextlib
+    import os
+    import socket
+    import subprocess
+    import time
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    env = dict(os.environ)
+    if args.platform == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        # TPU plugin site hooks initialize the backend at interpreter start,
+        # which multi-controller forbids — strip them for the CPU simulation
+        parts = [
+            p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon" not in p
+        ]
+        env["PYTHONPATH"] = os.pathsep.join(
+            parts + [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        )
+
+    os.makedirs(args.log_dir, exist_ok=True)
+    rc = 0
+    with contextlib.ExitStack() as stack:
+        procs: list = []
+        logs: list[str] = []
+        for pid in range(args.processes):
+            cmd = [
+                sys.executable, "-m", "llm_sharding_tpu", "worker",
+                args.shards,
+                "--coordinator", f"localhost:{port}",
+                "--processes", str(args.processes),
+                "--process-id", str(pid),
+                "--prompt", args.prompt,
+                "--max-new", str(args.max_new),
+                "--dtype", args.dtype,
+            ]
+            if args.stages:
+                cmd += ["--stages", str(args.stages)]
+            if args.ranges:
+                cmd += ["--ranges", args.ranges]
+            if args.local_devices:
+                cmd += ["--local-devices", str(args.local_devices)]
+            log_path = os.path.join(args.log_dir, f"worker_{pid}.log")
+            logs.append(log_path)
+            log = stack.enter_context(open(log_path, "w"))
+            p = subprocess.Popen(
+                cmd,
+                stdout=subprocess.PIPE if pid == 0 else log,
+                stderr=log,
+                text=True,
+                env=env,
+            )
+            stack.callback(lambda p=p: p.poll() is None and p.kill())
+            procs.append(p)
+
+        # Watchdog (≙ the reference's operator tailing node logs,
+        # run_this.sh:20-22 — but automated): one worker dying would leave
+        # the rest blocked in collectives until the coordination-service
+        # timeout, so kill the job as soon as any worker fails, and bound
+        # the whole launch with --timeout.
+        deadline = time.monotonic() + args.timeout if args.timeout else None
+        failed = None
+        while any(p.poll() is None for p in procs):
+            for pid, p in enumerate(procs):
+                if p.poll() is not None and p.returncode != 0:
+                    failed = (pid, p.returncode)
+                    break
+            if failed or (deadline and time.monotonic() > deadline):
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                if failed is None:
+                    failed = (-1, 124)
+                    print(
+                        f"launch timed out after {args.timeout}s; workers "
+                        "terminated",
+                        file=sys.stderr,
+                    )
+                break
+            time.sleep(0.2)
+        for pid, p in enumerate(procs):
+            try:
+                out, _ = p.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            if pid == 0 and out:
+                print(out, end="")
+            if p.returncode != 0:
+                rc = rc or p.returncode or 1
+                print(
+                    f"worker {pid} exited {p.returncode}; see {logs[pid]}",
+                    file=sys.stderr,
+                )
+    return rc
 
 
 def cmd_profile(args) -> int:
@@ -336,6 +490,51 @@ def build_parser() -> argparse.ArgumentParser:
     )
     s.add_argument("--dtype", default="bf16")
     s.set_defaults(fn=cmd_serve)
+
+    w = sub.add_parser(
+        "worker",
+        help="one multi-controller process (run one per host on a pod)",
+    )
+    w.add_argument("shards")
+    w.add_argument("--coordinator", required=True, help="host:port of process 0")
+    w.add_argument("--processes", type=int, required=True)
+    w.add_argument("--process-id", type=int, required=True, dest="process_id")
+    w.add_argument("--prompt", required=True)
+    w.add_argument("--max-new", type=int, default=64, dest="max_new")
+    w.add_argument("--stages", type=int)
+    w.add_argument("--ranges")
+    w.add_argument("--dtype", default="bf16")
+    w.add_argument(
+        "--local-devices", type=int, default=0, dest="local_devices",
+        help="force N virtual CPU devices per process (simulation)",
+    )
+    w.set_defaults(fn=cmd_worker)
+
+    la = sub.add_parser(
+        "launch",
+        help="spawn N workers on this host (multi-host simulation / pod crib)",
+    )
+    la.add_argument("shards")
+    la.add_argument("--processes", type=int, default=2)
+    la.add_argument("--prompt", required=True)
+    la.add_argument("--max-new", type=int, default=64, dest="max_new")
+    la.add_argument("--stages", type=int)
+    la.add_argument("--ranges")
+    la.add_argument("--dtype", default="bf16")
+    la.add_argument(
+        "--local-devices", type=int, default=0, dest="local_devices",
+    )
+    la.add_argument(
+        "--platform", default="cpu", choices=["cpu", "inherit"],
+        help="cpu: simulate the pod with virtual CPU devices (strips TPU "
+        "plugin hooks); inherit: pass the environment through",
+    )
+    la.add_argument("--log-dir", default="results/launch", dest="log_dir")
+    la.add_argument(
+        "--timeout", type=float, default=900.0,
+        help="kill all workers after this many seconds (0 = no limit)",
+    )
+    la.set_defaults(fn=cmd_launch)
 
     pr = sub.add_parser("profile", help="capability sweeps + artifacts")
     src = pr.add_mutually_exclusive_group(required=True)

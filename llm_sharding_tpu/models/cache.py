@@ -27,13 +27,18 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
 from .config import ModelConfig
 
 
-POS_SENTINEL = jnp.int32(2**30)  # "no key here" — larger than any real position
+# "no key here" — larger than any real position. Deliberately a NUMPY scalar:
+# a module-level jnp constant would initialize the XLA backend at import
+# time, which breaks multi-controller runs (jax.distributed.initialize must
+# run before any backend use — parallel/distributed.py).
+POS_SENTINEL = np.int32(2**30)
 
 
 class KVCache(NamedTuple):

@@ -69,3 +69,17 @@ def process_local_batch(global_batch: int) -> int:
             f"global batch {global_batch} not divisible by process count {n}"
         )
     return global_batch // n
+
+
+def put_global(arr, sharding) -> jax.Array:
+    """Host array → global ``jax.Array`` under ``sharding``, correct in BOTH
+    runtimes: single-controller (equivalent to ``jax.device_put``) and
+    multi-controller, where a plain ``device_put`` of host numpy onto a
+    sharding spanning non-addressable devices fails — the r2 missing-#1
+    blocker for multi-host. ``make_array_from_callback`` materializes ONLY
+    this process's addressable shards (each host slices its piece out of its
+    host-resident copy), so no host ever transfers another host's shard."""
+    arr = np.asarray(arr)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )

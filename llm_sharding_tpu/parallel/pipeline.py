@@ -379,6 +379,19 @@ def pipeline_generate(
         raise ValueError(f"batch {B} not divisible by data-parallel size {dp}")
 
     rng = jax.random.key_data(jax.random.key(seed))
+    if jax.process_count() > 1:
+        # Multi-controller: every host passes the same GLOBAL batch; each
+        # process materializes only its addressable slice (for dp meshes that
+        # is its process_local_batch rows — see parallel/distributed.py).
+        from jax.sharding import NamedSharding
+
+        from .distributed import put_global
+        from .mesh import DATA_AXIS
+
+        sh = NamedSharding(mesh, P(DATA_AXIS) if dp > 1 else P())
+        prompt_ids = put_global(prompt_ids, sh)
+        prompt_len = put_global(prompt_len, sh)
+        rng = put_global(rng, NamedSharding(mesh, P()))
     out, lengths = _pipeline_generate_jit(
         cfg,
         mesh,
@@ -395,4 +408,11 @@ def pipeline_generate(
         float(temperature),
         int(top_k),
     )
+    if jax.process_count() > 1 and dp > 1:
+        # dp-sharded outputs span non-addressable devices; assemble the
+        # global value on every host (small: token ids + lengths)
+        from jax.experimental import multihost_utils
+
+        out = multihost_utils.process_allgather(out, tiled=True)
+        lengths = multihost_utils.process_allgather(lengths, tiled=True)
     return PipelineResult(np.asarray(out), np.asarray(lengths))

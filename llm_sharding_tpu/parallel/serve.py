@@ -96,6 +96,8 @@ def make_state(
     act_dtype=jnp.bfloat16,
 ) -> ServeState:
     """Host-constructed empty state (all slots free / done)."""
+    from .distributed import put_global
+
     S = mesh.shape[PIPE_AXIS]
     Bs = batch_per_slot
     M = S * Bs
@@ -105,27 +107,33 @@ def make_state(
     dev = NamedSharding(mesh, P(PIPE_AXIS))
     rep = NamedSharding(mesh, P())
 
+    # host-built numpy + put_global: identical to device_put on one
+    # controller, and each process materializes only its addressable shards
+    # under multi-controller SPMD (see parallel/distributed.py)
     def put(arr, sh):
-        return jax.device_put(arr, sh)
+        return put_global(arr, sh)
+
+    def zeros(shape, dtype):
+        return np.zeros(shape, dtype)  # ml_dtypes (bf16 etc.) are np-valid
 
     kv_shape = (S, Lp, M, C, cfg.num_key_value_heads, cfg.head_dim_)
     state = ServeState(
-        k=put(jnp.zeros(kv_shape, cache_dtype), dev),
-        v=put(jnp.zeros(kv_shape, cache_dtype), dev),
-        kpos=put(jnp.full((S, M, C), POS_SENTINEL, jnp.int32), dev),
-        h=put(jnp.zeros((S, Bs, 1, H), act_dtype), dev),
-        h_valid=put(jnp.zeros((S,), jnp.bool_), dev),
-        pos_slots=put(jnp.zeros((S, M), jnp.int32), dev),
-        write_off=put(jnp.zeros((S, S), jnp.int32), dev),
-        out=put(jnp.zeros((M, C), jnp.int32), rep),
-        lengths=put(jnp.zeros((M,), jnp.int32), rep),
-        done=put(jnp.ones((M,), jnp.bool_), rep),
-        budget=put(jnp.zeros((M,), jnp.int32), rep),
-        inject=put(jnp.zeros((M, 1, H), act_dtype), rep),
-        inject_pending=put(jnp.zeros((M,), jnp.bool_), rep),
-        rng=put(jnp.zeros((M, 2), jnp.uint32), rep),
-        temp=put(jnp.zeros((M,), jnp.float32), rep),
-        m=put(jnp.zeros((), jnp.int32), rep),
+        k=put(zeros(kv_shape, cache_dtype), dev),
+        v=put(zeros(kv_shape, cache_dtype), dev),
+        kpos=put(np.full((S, M, C), int(POS_SENTINEL), np.int32), dev),
+        h=put(zeros((S, Bs, 1, H), act_dtype), dev),
+        h_valid=put(zeros((S,), np.bool_), dev),
+        pos_slots=put(zeros((S, M), np.int32), dev),
+        write_off=put(zeros((S, S), np.int32), dev),
+        out=put(zeros((M, C), np.int32), rep),
+        lengths=put(zeros((M,), np.int32), rep),
+        done=put(np.ones((M,), np.bool_), rep),
+        budget=put(zeros((M,), np.int32), rep),
+        inject=put(zeros((M, 1, H), act_dtype), rep),
+        inject_pending=put(zeros((M,), np.bool_), rep),
+        rng=put(zeros((M, 2), np.uint32), rep),
+        temp=put(zeros((M,), np.float32), rep),
+        m=put(zeros((), np.int32), rep),
     )
     return state
 

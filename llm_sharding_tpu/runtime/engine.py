@@ -141,22 +141,27 @@ class PipelineEngine:
 
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from ..parallel.distributed import put_global
         from ..parallel.head import VOCAB_SHARDED, shard_head_host
 
         stage_np, masks_np = stack_stage_params(spec, self._full_layers)
         pipe_shard = NamedSharding(mesh, P(PIPE_AXIS))  # axis 0 → stages
         repl = NamedSharding(mesh, P())
+        # put_global (not device_put): each process materializes only its
+        # addressable shards, so the same code path serves single-controller
+        # and multi-controller runs (r2 missing #1 — the host-numpy
+        # device_put broke under multi-host SPMD).
         stage_layers = jax.tree.map(
-            lambda a: jax.device_put(a, pipe_shard), stage_np
+            lambda a: put_global(a, pipe_shard), stage_np
         )
-        masks = jax.device_put(masks_np, pipe_shard)
+        masks = put_global(masks_np, pipe_shard)
         # Vocab-shard the embedding/lm_head over the pipe axis: each chip
         # holds only its V/num_stages slice (≙ the reference's role split —
         # embedding on user-facing nodes, lm_head on the last node,
         # node_worker.py:105-125, 155-164 — done as vocab parallelism).
         head_np = shard_head_host(self.cfg, self._head_host, spec.num_stages)
         head_params = {
-            k: jax.device_put(v, pipe_shard if k in VOCAB_SHARDED else repl)
+            k: put_global(v, pipe_shard if k in VOCAB_SHARDED else repl)
             for k, v in head_np.items()
         }
         # Swap everything atomically — a concurrent generate sees either the

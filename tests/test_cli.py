@@ -279,3 +279,39 @@ def test_serve_placement_rollback_on_rebuild_failure(shards, capsys, monkeypatch
     lines = [l for l in captured.out.splitlines() if l.strip()]
     assert len(lines) == 2 and lines[0] == lines[1]
     assert '"requests_completed": 2' in captured.err
+
+
+def test_launch_two_process_simulation(tmp_path, capsys):
+    """``launch`` spawns N jax.distributed workers on this host (≙ the
+    reference's run_this.sh:8-17 spawning per-node daemons with per-node
+    logs) and worker 0 prints the completion."""
+    from tokenizers import Tokenizer, models, pre_tokenizers
+
+    store = str(tmp_path / "store")
+    params = llama.init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+    shard_store.save_shards(CFG, params, store)
+    vocab = {c: i + 3 for i, c in enumerate("abcdefghijklmnopqrstuvwxyz ")}
+    vocab.update({"[UNK]": 0, "[BOS]": 1, "[EOS]": 2})
+    t = Tokenizer(models.WordLevel(vocab, unk_token="[UNK]"))
+    t.pre_tokenizer = pre_tokenizers.Split("", "isolated")
+    t.save(os.path.join(store, "tokenizer.json"))
+    with open(os.path.join(store, "tokenizer_config.json"), "w") as f:
+        json.dump(
+            {"tokenizer_class": "PreTrainedTokenizerFast", "unk_token": "[UNK]"},
+            f,
+        )
+
+    log_dir = str(tmp_path / "logs")
+    rc = cli.main(
+        [
+            "launch", store, "--processes", "2", "--local-devices", "2",
+            "--prompt", "hello", "--max-new", "4", "--dtype", "f32",
+            "--log-dir", log_dir,
+        ]
+    )
+    assert rc == 0
+    assert capsys.readouterr().out.strip(), "worker 0 printed no completion"
+    assert os.path.exists(os.path.join(log_dir, "worker_0.log"))
+    assert os.path.exists(os.path.join(log_dir, "worker_1.log"))
+    with open(os.path.join(log_dir, "worker_1.log")) as f:
+        assert "2 processes, 4 global devices" in f.read()
