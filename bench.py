@@ -5,19 +5,23 @@ The headline metric (3B single-chip greedy decode, the round-1/2 metric,
 unchanged methodology) is printed LAST so drivers that keep only the final
 line still record it.
 
-Metrics (VERDICT r2 next-#2):
+Metrics (VERDICT r2 next-#2, plus int8):
   a. decode_tok_s_llama2-7b_1chip   — largest 7B-family config on one chip
      (Llama-2-7B bf16 ~13.5 GB; if it doesn't fit, an explicit error line is
      emitted — no silent downgrade).
-  b. serve_tok_s_llama3.2-3b_1stage — steady-state continuous-batching
+  b. decode_tok_s_llama2-7b-int8_1chip — the same model with int8-resident
+     weights (≙ the reference's load_in_8bit mode; decode is weight-read
+     bandwidth-bound, so int8 is a direct throughput lever — ops/quant.py).
+  c. serve_tok_s_llama3.2-3b_1stage — steady-state continuous-batching
      throughput: serve_admit + serve_chunk on a 1-stage mesh (the
      PipelineServer path, previously never timed on hardware).
-  c. pallas_prefill_speedup_s2048   — fused flash-attention kernel vs the XLA
+  d. pallas_prefill_speedup_s2048   — fused flash-attention kernel vs the XLA
      score-materializing path at S=C=2048, llama3-8b head geometry, with an
      on-chip numeric cross-check (bf16).
-  d. decode_tok_s_llama3.2-3b_1chip_c4096 — decode against a 4096-slot KV
+  e. decode_tok_s_llama3.2-3b_1chip_c4096 — decode against a 4096-slot KV
      cache (segmented-decode path; r2 weak #3).
-  e. decode_tok_s_llama3.2-3b_1chip — the no-regression anchor metric.
+  f. decode_tok_s_llama3.2-3b-int8_1chip — 3B int8 decode.
+  g. decode_tok_s_llama3.2-3b_1chip — the no-regression anchor metric.
 
 vs_baseline for throughput metrics is tok/s divided by the reference world's
 only number: the ~4 tok/s anecdotal anchor (`/root/reference/start_node.py:20`
@@ -56,6 +60,28 @@ def emit_error(metric, unit, err):
 ANCHOR_TOK_S = 4.0  # BASELINE.md anecdotal anchor
 
 
+def int8_metric_name(name: str) -> str:
+    return name.replace("_1chip", "-int8_1chip").replace("_cpu", "-int8_cpu")
+
+
+def bench_int8_variant(name, cfg, params, prompt_len, max_new, generate):
+    """Quantize ``params`` in place (donating) and emit the int8 decode
+    metric for ``name``. Returns the quantized params (the bf16 input is
+    consumed)."""
+    from llm_sharding_tpu.ops.quant import quantize_params
+
+    n8 = int8_metric_name(name)
+    try:
+        params = quantize_params(params, donate=True)
+        tok_s8 = time_decode(
+            cfg, params, prompt_len, max_new, prompt_len + max_new, generate
+        )
+        emit(n8, tok_s8, "tokens/sec", tok_s8 / ANCHOR_TOK_S)
+    except Exception as e:  # noqa: BLE001
+        emit_error(n8, "tokens/sec", e)
+    return params
+
+
 def time_decode(cfg, params, prompt_len, max_new, capacity, generate):
     """Compile (warm-up) then time one full generate() call — the reference
     profiler's warm-up + synchronize discipline
@@ -87,6 +113,9 @@ def bench_7b(on_tpu, jax, jnp):
         cfg, params, prompt_len, max_new, prompt_len + max_new, generate
     )
     emit(name, tok_s, "tokens/sec", tok_s / ANCHOR_TOK_S)
+
+    # int8-resident weights (donating quantization: peak = params + one leaf)
+    params = bench_int8_variant(name, cfg, params, prompt_len, max_new, generate)
     del params
     gc.collect()
 
@@ -125,6 +154,9 @@ def bench_3b(on_tpu, jax, jnp):
         cfg, params, prompt_len, max_new, prompt_len + max_new, generate
     )
     params_np = jax.tree.map(np.asarray, params)
+    params = bench_int8_variant(
+        names[1], cfg, params, prompt_len, max_new, generate
+    )
     del params
     gc.collect()
     return cfg, params_np, names[1], tok_s

@@ -33,11 +33,21 @@ import numpy as np
 def _dtype(name: str):
     import jax.numpy as jnp
 
-    return {
+    table = {
         "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
         "f32": jnp.float32, "float32": jnp.float32,
         "f16": jnp.float16, "float16": jnp.float16,
-    }[name]
+    }
+    if name not in table:
+        hint = (
+            " (int8 is a convert-time option; int8 stores load with any "
+            "compute dtype — pass e.g. --dtype bf16)"
+            if name == "int8" else ""
+        )
+        raise SystemExit(
+            f"unknown dtype {name!r}; choose from {sorted(set(table))}{hint}"
+        )
+    return table[name]
 
 
 def _parse_ranges(text: str):
@@ -73,12 +83,23 @@ def _engine(args):
 
 
 def cmd_convert(args) -> int:
+    import jax.numpy as jnp
+
     from .utils.shard_store import convert_hf_checkpoint
 
-    cfg = convert_hf_checkpoint(args.model_dir, args.out_dir, _dtype(args.dtype))
+    if args.dtype == "int8":
+        # ≙ the reference's load_in_8bit conversion (model_sharder.py:28-45):
+        # layer matmul weights stored int8 + per-channel bf16 scales
+        dtype, quantize = jnp.bfloat16, True
+    else:
+        dtype, quantize = _dtype(args.dtype), False
+    cfg = convert_hf_checkpoint(
+        args.model_dir, args.out_dir, dtype, quantize=quantize
+    )
     print(
         f"converted {cfg.model_type} ({cfg.num_hidden_layers} layers, "
-        f"vocab {cfg.vocab_size}) -> {args.out_dir}"
+        f"vocab {cfg.vocab_size}{', int8' if quantize else ''}) "
+        f"-> {args.out_dir}"
     )
     return 0
 
@@ -322,6 +343,17 @@ def cmd_launch(args) -> int:
             stack.callback(lambda p=p: p.poll() is None and p.kill())
             procs.append(p)
 
+        # drain worker 0's stdout concurrently: a completion larger than the
+        # OS pipe buffer would otherwise block the worker forever
+        import threading
+
+        out0_parts: list[str] = []
+        drain0 = threading.Thread(
+            target=lambda: out0_parts.append(procs[0].stdout.read()),
+            daemon=True,
+        )
+        drain0.start()
+
         # Watchdog (≙ the reference's operator tailing node logs,
         # run_this.sh:20-22 — but automated): one worker dying would leave
         # the rest blocked in collectives until the coordination-service
@@ -349,18 +381,19 @@ def cmd_launch(args) -> int:
             time.sleep(0.2)
         for pid, p in enumerate(procs):
             try:
-                out, _ = p.communicate(timeout=30)
+                p.wait(timeout=30)
             except subprocess.TimeoutExpired:
                 p.kill()
-                out, _ = p.communicate()
-            if pid == 0 and out:
-                print(out, end="")
+                p.wait()
             if p.returncode != 0:
                 rc = rc or p.returncode or 1
                 print(
                     f"worker {pid} exited {p.returncode}; see {logs[pid]}",
                     file=sys.stderr,
                 )
+        drain0.join(timeout=10)
+        if out0_parts and out0_parts[0]:
+            print(out0_parts[0], end="")
     return rc
 
 

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 
 from ..ops.flash_attention import attention_step
 from ..ops.norms import layer_norm
+from ..ops.quant import qmatmul
 from .cache import KVCache
 from .config import ModelConfig
 from .stack import scan_layers
@@ -90,7 +91,7 @@ def decoder_layer(
     D = cfg.head_dim_
 
     x = layer_norm(h, p["ln1_w"], p["ln1_b"], cfg.layer_norm_epsilon)
-    qkv = x @ p["w_qkv"] + p["b_qkv"]  # [B, S, 3H]
+    qkv = qmatmul(x, p["w_qkv"]) + p["b_qkv"]  # [B, S, 3H]
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(B, S, Nh, D)
     k = k.reshape(B, S, Nh, D)
@@ -100,11 +101,11 @@ def decoder_layer(
     v_row = jax.lax.dynamic_update_slice(v_row, v.astype(v_row.dtype), (0, length, 0, 0))
 
     attn = attention_step(q, k_row, v_row, positions, kv_positions, length)
-    h = h + attn.reshape(B, S, H) @ p["w_proj"] + p["b_proj"]
+    h = h + qmatmul(attn.reshape(B, S, H), p["w_proj"]) + p["b_proj"]
 
     x = layer_norm(h, p["ln2_w"], p["ln2_b"], cfg.layer_norm_epsilon)
-    mlp = jax.nn.gelu((x @ p["w_fc"] + p["b_fc"]).astype(jnp.float32), approximate=True)
-    h = h + mlp.astype(x.dtype) @ p["w_out"] + p["b_out"]
+    mlp = jax.nn.gelu((qmatmul(x, p["w_fc"]) + p["b_fc"]).astype(jnp.float32), approximate=True)
+    h = h + qmatmul(mlp.astype(x.dtype), p["w_out"]) + p["b_out"]
     return h, k_row, v_row
 
 

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from ..ops.flash_attention import attention_step
 from ..ops.norms import rms_norm
+from ..ops.quant import out_dim, qmatmul
 from ..ops.rope import apply_rope, rope_cos_sin
 from .cache import KVCache
 from .config import ModelConfig
@@ -120,23 +121,28 @@ def attn_mlp_block(
     """
     B, S, H = h.shape
     D = cfg.head_dim_
-    Nh = p["wq"].shape[-1] // D  # local (possibly TP-sharded) head counts
-    Nkv = p["wk"].shape[-1] // D
+    # local (possibly TP-sharded) head counts from the weight shapes, raw or
+    # int8-quantized (ops/quant.py)
+    Nh = out_dim(p["wq"]) // D
+    Nkv = out_dim(p["wk"]) // D
 
     x = rms_norm(h, p["input_norm"], cfg.rms_norm_eps)
-    q = apply_rope((x @ p["wq"]).reshape(B, S, Nh, D), cos, sin)
-    k = apply_rope((x @ p["wk"]).reshape(B, S, Nkv, D), cos, sin)
-    v = (x @ p["wv"]).reshape(B, S, Nkv, D)
+    q = apply_rope(qmatmul(x, p["wq"]).reshape(B, S, Nh, D), cos, sin)
+    k = apply_rope(qmatmul(x, p["wk"]).reshape(B, S, Nkv, D), cos, sin)
+    v = qmatmul(x, p["wv"]).reshape(B, S, Nkv, D)
 
     attn = attn_fn(q, k, v)
-    attn_out = attn.reshape(B, S, Nh * D) @ p["wo"]
+    attn_out = qmatmul(attn.reshape(B, S, Nh * D), p["wo"])
     if tp_axis is not None:
         attn_out = jax.lax.psum(attn_out, tp_axis)
     h = h + attn_out
 
     x = rms_norm(h, p["post_norm"], cfg.rms_norm_eps)
-    mlp = (jax.nn.silu((x @ p["w_gate"]).astype(jnp.float32)).astype(x.dtype)
-           * (x @ p["w_up"])) @ p["w_down"]
+    mlp = qmatmul(
+        jax.nn.silu(qmatmul(x, p["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+        * qmatmul(x, p["w_up"]),
+        p["w_down"],
+    )
     if tp_axis is not None:
         mlp = jax.lax.psum(mlp, tp_axis)
     return h + mlp

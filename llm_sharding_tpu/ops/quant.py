@@ -1,0 +1,147 @@
+"""Int8 weight quantization: HBM-resident int8 weights, dequant fused into
+the matmul.
+
+Parity + perf in one mechanism. The reference loads checkpoints in int8/int4
+through bitsandbytes (``/root/reference/utils/model_sharder.py:28-45`` —
+``load_in_8bit``/``load_in_4bit``, weights stay quantized on the device); the
+TPU-native equivalent keeps weights as int8 arrays in HBM with
+per-output-channel scales and lets XLA fuse the int8→bf16 convert into the
+dot's operand load. Single-chip decode is weight-read bandwidth-bound, so
+halving weight bytes is a direct throughput lever (measured on v5e, 3B:
+see ``bench.py`` int8 metric).
+
+Scheme: symmetric per-output-channel absmax. For a weight ``[.., in, out]``
+the scale is ``absmax(w, axis=in) / 127`` per ``out`` column (stacked layer
+weights ``[L, in, out]`` get per ``(L, out)`` scales). The matmul computes
+``(x @ q.astype(x.dtype)) * scale`` — the scale factors out of the dot
+because it is constant along the contracted axis.
+
+``QTensor`` is a NamedTuple, hence automatically a pytree: layer stacking,
+``lax.scan`` over stacked layers, shard_map pytree-prefix specs, and the
+engine's host/device moves all work unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class QTensor(NamedTuple):
+    q: jax.Array  # int8, same shape as the original weight [.., in, out]
+    scale: jax.Array  # [.., out] per-output-channel scale (original dtype)
+
+
+WeightLike = Union[jax.Array, np.ndarray, QTensor]
+
+
+# Two separate jits, deliberately: in one program XLA CSEs the two uses of
+# w.astype(f32) (the absmax reduce and the quantize chain) into a
+# MATERIALIZED fp32 copy of the weight — 5.8 GB for a 7B-class stacked leaf,
+# which OOMs next to the bf16 params. Split, each use fuses into its own
+# loop and no fp32 buffer ever exists. The donating variant frees each bf16
+# leaf as its int8 replacement is produced (peak = params + one int8 leaf).
+@functools.partial(jax.jit, static_argnames=("contract_axis",))
+def _absmax_jit(w, contract_axis: int):
+    return jnp.max(jnp.abs(w.astype(jnp.float32)), axis=contract_axis)
+
+
+def _q_impl(w, denom):
+    return jnp.round(w.astype(jnp.float32) / denom * 127.0).astype(jnp.int8)
+
+
+_q_jit = jax.jit(_q_impl)
+_q_donate_jit = jax.jit(_q_impl, donate_argnums=(0,))
+
+
+def quantize_tensor(w, contract_axis: int = -2, donate: bool = False) -> QTensor:
+    """Symmetric per-output-channel int8 quantization. ``contract_axis`` is
+    the axis a matmul contracts over (the scale must be constant along it to
+    factor out of the dot). ``donate=True`` consumes ``w`` (device buffers
+    freed as the quantized copy is produced)."""
+    w = jnp.asarray(w)
+    absmax = _absmax_jit(w, contract_axis=contract_axis)
+    scale = (absmax / 127.0).astype(w.dtype)
+    denom = jnp.expand_dims(jnp.maximum(absmax, 1e-12), contract_axis)
+    q = (_q_donate_jit if donate else _q_jit)(w, denom)
+    if donate:
+        # block so the donated bf16 buffer is actually released before the
+        # NEXT leaf's dispatch allocates its outputs — async dispatch
+        # reserves output buffers ahead of execution, and at 7B scale the
+        # un-released inputs + reserved outputs overrun HBM
+        jax.block_until_ready(q)
+    return QTensor(q=q, scale=scale)
+
+
+def dequantize(t: QTensor, contract_axis: int = -2) -> jnp.ndarray:
+    scale = jnp.expand_dims(t.scale, contract_axis)
+    return t.q.astype(scale.dtype) * scale
+
+
+def out_dim(w: WeightLike) -> int:
+    """Output (last-axis) size of a maybe-quantized weight."""
+    return (w.q if isinstance(w, QTensor) else w).shape[-1]
+
+
+def qmatmul(x: jnp.ndarray, w: WeightLike) -> jnp.ndarray:
+    """``x @ w`` accepting a raw array or a QTensor. For QTensor the int8
+    operand is cast inside the dot (XLA fuses the convert into the operand
+    load — no bf16 copy of the weight materializes in HBM) and the
+    per-column scale is applied to the product."""
+    if isinstance(w, QTensor):
+        return (x @ w.q.astype(x.dtype)) * w.scale.astype(x.dtype)
+    return x @ w
+
+
+# Layer-weight keys quantized by default: the matmul weights. Norm gains and
+# biases stay in the model dtype (tiny, precision-critical).
+LLAMA_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+GPT2_QUANT_KEYS = ("w_qkv", "w_out", "w_fc", "w_proj")
+
+
+def quantize_layer_params(layers: dict, keys=None, donate: bool = False) -> dict:
+    """Quantize a (stacked ``[L, in, out]``) layer pytree's matmul weights.
+    Unknown keys pass through untouched. ``donate=True`` consumes each input
+    leaf as its int8 replacement is produced (peak memory = params + one
+    int8 leaf — required to quantize a 7B-class model in place on a 16 GB
+    chip; the caller's original arrays are invalidated)."""
+    if keys is None:
+        keys = LLAMA_QUANT_KEYS + GPT2_QUANT_KEYS
+    if not donate:
+        return {
+            k: (
+                quantize_tensor(v)
+                if k in keys and not isinstance(v, QTensor)
+                else v
+            )
+            for k, v in layers.items()
+        }
+    # Donating: POP each leaf out of the input dict so ours is the last
+    # reference — a buffer that is still referenced elsewhere cannot actually
+    # be released at donation time. The input dict is emptied (consumed).
+    out: dict = {}
+    for k in list(layers.keys()):
+        v = layers.pop(k)
+        if k in keys and not isinstance(v, QTensor):
+            out[k] = quantize_tensor(v, donate=True)
+        else:
+            out[k] = v
+        del v
+    return out
+
+
+def quantize_params(params: dict, keys=None, donate: bool = False) -> dict:
+    """Quantize a full model params pytree's layer weights (embedding /
+    head / norms stay in the model dtype — the vocab tables are already
+    vocab-sharded across the pipe axis, see parallel/head.py)."""
+    out = dict(params)
+    out["layers"] = quantize_layer_params(params["layers"], keys, donate=donate)
+    return out
+
+
+def is_quantized(layers: dict) -> bool:
+    return any(isinstance(v, QTensor) for v in layers.values())

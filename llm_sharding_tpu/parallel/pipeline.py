@@ -372,9 +372,15 @@ def pipeline_generate(
 
     dp, _, tp = mesh_axis_sizes(mesh)
     if tp > 1:
+        from ..ops.quant import is_quantized
         from .tensor import validate_tp
 
         validate_tp(cfg, tp)
+        if is_quantized(stage_layers):
+            raise NotImplementedError(
+                "tensor parallelism over int8-quantized weights is not "
+                "supported yet (QTensor leaves need per-component specs)"
+            )
     if B % dp != 0:
         raise ValueError(f"batch {B} not divisible by data-parallel size {dp}")
 

@@ -73,8 +73,15 @@ def validate_tp(cfg: ModelConfig, tp: int) -> None:
 
 def shard_params_tp(cfg: ModelConfig, params: Any, mesh: Mesh) -> Any:
     """device_put params with megatron shardings; GSPMD does the rest."""
+    from ..ops.quant import is_quantized
+
     if cfg.model_type != "llama":
         raise NotImplementedError("TP specs: llama family first")
+    if is_quantized(params["layers"]):
+        raise NotImplementedError(
+            "tensor parallelism over int8-quantized weights is not "
+            "supported yet (QTensor leaves need per-component specs)"
+        )
     tp = mesh.shape[TENSOR_AXIS]
     validate_tp(cfg, tp)
     specs = llama_tp_specs()

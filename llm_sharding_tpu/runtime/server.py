@@ -121,8 +121,12 @@ class PipelineServer:
         self.capacity = capacity
         self.chunk_cycles = chunk_cycles
         # top-k is server-level (a static program parameter — per-request
-        # values would recompile serve_chunk); temperature/seed are per-request
+        # values would recompile serve_chunk); temperature/seed are per-request.
+        # The decode program compiles greedy-only until the first sampled
+        # request arrives (the sampler costs ~20% steady-state throughput),
+        # then sticks with the sampling variant.
         self.top_k = top_k
+        self._sampling = top_k > 0
         # chunked admission (r2 weak #4): prompts longer than this are
         # prefilled in bounded chunks with decode cycles interleaved, so a
         # long admission never stalls live streams. None → one-shot admit.
@@ -191,6 +195,8 @@ class PipelineServer:
             next(self._ids), prompt, max_new_tokens,
             temperature=temperature, seed=seed,
         )
+        if temperature > 0:
+            self._sampling = True
         self._queue.append(req)
         self.counters.requests_submitted += 1
         logger.info(
@@ -213,6 +219,7 @@ class PipelineServer:
                 self.num_stages,
                 self.num_stages * self.chunk_cycles,
                 self.top_k,
+                self._sampling,
             )
             self.counters.chunks += 1
             progressed = True
@@ -382,6 +389,7 @@ class PipelineServer:
                     self.num_stages,
                     self.num_stages,  # one ring cycle between chunks
                     self.top_k,
+                    self._sampling,
                 )
                 self.counters.chunks += 1
                 self._fetch()

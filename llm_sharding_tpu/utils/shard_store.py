@@ -37,6 +37,7 @@ import shutil
 from typing import Any, Optional
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..models.config import ModelConfig
@@ -55,35 +56,63 @@ _WEIGHT_SUFFIXES = (".bin", ".safetensors", ".pth", ".pt", ".gguf")
 # numpy's npz format cannot round-trip ml_dtypes extension types (bf16 etc.
 # are written as raw void and cannot be cast back on load), so such arrays
 # are stored as same-width integer views plus a `<name>__dtype` tag.
+# Int8-quantized weights (ops/quant.QTensor) are stored as a `<name>__q`
+# int8 array + `<name>__scale` pair and reassembled on load (≙ the
+# reference's load_in_8bit stores, ``model_sharder.py:28-45`` — quantized on
+# disk AND in device memory).
 _DTYPE_TAG = "__dtype"
+_Q_SUFFIX = "__q"
+_SCALE_SUFFIX = "__scale"
 _INT_VIEW = {1: np.uint8, 2: np.uint16, 4: np.uint32}
 
 
+def _encode_array(out: dict, k: str, v) -> None:
+    a = np.asarray(v)
+    if a.dtype.kind == "V":  # ml_dtypes extension types report kind 'V'
+        out[k] = a.view(_INT_VIEW[a.dtype.itemsize])
+        out[k + _DTYPE_TAG] = np.asarray(a.dtype.name)
+    else:
+        out[k] = a
+
+
 def _save_npz(path: str, arrays: dict[str, Any]) -> None:
+    from ..ops.quant import QTensor
+
     out: dict[str, np.ndarray] = {}
     for k, v in arrays.items():
-        a = np.asarray(v)
-        if a.dtype.kind == "V":  # ml_dtypes extension types report kind 'V'
-            out[k] = a.view(_INT_VIEW[a.dtype.itemsize])
-            out[k + _DTYPE_TAG] = np.asarray(a.dtype.name)
+        if isinstance(v, QTensor):
+            _encode_array(out, k + _Q_SUFFIX, v.q)
+            _encode_array(out, k + _SCALE_SUFFIX, v.scale)
         else:
-            out[k] = a
+            _encode_array(out, k, v)
     np.savez(path, **out)
 
 
-def _load_npz(path: str, dtype) -> dict[str, jnp.ndarray]:
+def _load_npz(path: str, dtype) -> dict[str, Any]:
     import ml_dtypes
 
+    from ..ops.quant import QTensor
+
+    def decode(z, k) -> np.ndarray:
+        a = z[k]
+        tag = k + _DTYPE_TAG
+        if tag in z.files:
+            a = a.view(np.dtype(getattr(ml_dtypes, str(z[tag]))))
+        return a
+
     with np.load(path) as z:
-        res = {}
+        res: dict[str, Any] = {}
         for k in z.files:
-            if k.endswith(_DTYPE_TAG):
+            if k.endswith(_DTYPE_TAG) or k.endswith(_SCALE_SUFFIX):
                 continue
-            a = z[k]
-            tag = k + _DTYPE_TAG
-            if tag in z.files:
-                a = a.view(np.dtype(getattr(ml_dtypes, str(z[tag]))))
-            res[k] = jnp.asarray(a, dtype)
+            if k.endswith(_Q_SUFFIX):
+                base = k[: -len(_Q_SUFFIX)]
+                res[base] = QTensor(
+                    q=jnp.asarray(decode(z, k)),  # stays int8
+                    scale=jnp.asarray(decode(z, base + _SCALE_SUFFIX), dtype),
+                )
+            else:
+                res[k] = jnp.asarray(decode(z, k), dtype)
         return res
 
 
@@ -107,9 +136,10 @@ def save_shards(
 
     layers = src["layers"]
     for i in range(cfg.num_hidden_layers):
+        # tree.map slices through QTensor leaves (q AND scale) correctly
         _save_npz(
             os.path.join(out_dir, f"block_{i}.npz"),
-            {k: v[i] for k, v in layers.items()},
+            jax.tree.map(lambda a, i=i: a[i], layers),
         )
 
     fn = {"final_norm": src["final_norm"]}
@@ -126,8 +156,15 @@ def save_shards_streaming(
     out_dir: str,
     dtype=jnp.bfloat16,
     tokenizer_dir: Optional[str] = None,
+    quantize: bool = False,
 ) -> None:
-    """Split directly from an HF name→tensor source, one unit at a time."""
+    """Split directly from an HF name→tensor source, one unit at a time.
+    ``quantize`` stores layer matmul weights int8 (per-output-channel
+    scales in ``dtype``) — ≙ the reference's ``load_in_8bit`` conversion
+    mode (``model_sharder.py:28-45``); vocab tables and norms stay ``dtype``.
+    """
+    from ..ops.quant import quantize_layer_params
+
     get = _getter(src)
     os.makedirs(out_dir, exist_ok=True)
     with open(os.path.join(out_dir, "config.json"), "w") as f:
@@ -137,7 +174,10 @@ def save_shards_streaming(
 
     layer_fn = llama_layer_arrays if cfg.model_type == "llama" else gpt2_layer_arrays
     for i in range(cfg.num_hidden_layers):
-        _save_npz(os.path.join(out_dir, f"block_{i}.npz"), layer_fn(cfg, get, i, dtype))
+        block = layer_fn(cfg, get, i, dtype)
+        if quantize:
+            block = quantize_layer_params(block)
+        _save_npz(os.path.join(out_dir, f"block_{i}.npz"), block)
 
     if cfg.model_type == "llama":
         embed = jnp.asarray(get("model.embed_tokens.weight"), dtype)
@@ -224,12 +264,11 @@ def load_stage(
     pad_to = pad_to or n
     if pad_to < n:
         raise ValueError(f"pad_to={pad_to} < stage size {n}")
-    stacked = {}
-    for k in blocks[0]:
-        arrs = [b[k] for b in blocks]
-        if pad_to > n:
-            arrs += [jnp.zeros_like(arrs[0])] * (pad_to - n)
-        stacked[k] = jnp.stack(arrs)
+    if pad_to > n:
+        pad_block = jax.tree.map(jnp.zeros_like, blocks[0])
+        blocks = blocks + [pad_block] * (pad_to - n)
+    # stacks through QTensor leaves (q and scale stacked independently)
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
 
     stage: dict[str, Any] = {
         "layers": stacked,
@@ -261,10 +300,11 @@ def load_full(shards_dir: str, dtype=jnp.bfloat16) -> tuple[ModelConfig, dict]:
 
 
 def convert_hf_checkpoint(
-    model_dir: str, out_dir: str, dtype=jnp.bfloat16
+    model_dir: str, out_dir: str, dtype=jnp.bfloat16, quantize: bool = False
 ) -> ModelConfig:
     """Offline conversion entry (≙ running ``ModelSharder`` as a script,
-    ``/root/reference/utils/model_sharder.py:137-145``).
+    ``/root/reference/utils/model_sharder.py:137-145``; ``quantize`` ≙ its
+    int8 mode, ``:28-45``).
 
     Reads HF ``config.json`` + ``*.safetensors`` (or torch ``*.bin``) from
     ``model_dir``, streams tensors, writes the shard store to ``out_dir``.
@@ -313,7 +353,10 @@ def convert_hf_checkpoint(
             return sd[name]
 
     try:
-        save_shards_streaming(cfg, get, out_dir, dtype, tokenizer_dir=model_dir)
+        save_shards_streaming(
+            cfg, get, out_dir, dtype, tokenizer_dir=model_dir,
+            quantize=quantize,
+        )
     finally:
         for h in handles:
             close = getattr(h, "close", None)

@@ -114,3 +114,24 @@ def test_convert_bf16_store_servable(hf_checkpoint, tmp_path):
     eng = PipelineEngine.from_shards(out, num_stages=2, dtype=jnp.bfloat16)
     text = eng.generate_text("hello world", 8)
     assert isinstance(text, str)
+
+
+def test_convert_int8_store_servable(hf_checkpoint, tmp_path):
+    """--dtype int8 conversion (≙ the reference's load_in_8bit mode,
+    model_sharder.py:28-45): layer weights stored int8 + per-channel scales,
+    reassembled as QTensor on load, servable through the pipeline."""
+    from llm_sharding_tpu.ops.quant import QTensor
+    from llm_sharding_tpu.runtime.engine import PipelineEngine
+    from llm_sharding_tpu.utils import shard_store
+
+    d, _, _ = hf_checkpoint
+    out = str(tmp_path / "store_int8")
+    convert_hf_checkpoint(d, out, dtype=jnp.float32, quantize=True)
+
+    _, params = shard_store.load_full(out, dtype=jnp.float32)
+    assert isinstance(params["layers"]["wq"], QTensor)
+    assert params["layers"]["wq"].q.dtype == jnp.int8
+
+    eng = PipelineEngine.from_shards(out, num_stages=4, dtype=jnp.float32)
+    text = eng.generate_text("the quick brown fox", 8)
+    assert isinstance(text, str)
