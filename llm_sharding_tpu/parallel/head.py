@@ -250,6 +250,32 @@ def sp_sample(
     return _assemble_argmax(scaled + g, lo)
 
 
+def seed_chain_init(seeds: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-row key chains from integer seeds: ``key(seed) → split``, exactly
+    the monolith's first step (``runtime/generate.py``). Returns raw uint32
+    key data ``(new_keys [B,2], subs [B,2])`` — ``subs`` samples the first
+    token, ``new_keys`` is the stored chain. ONE definition shared by the
+    serve and interleaved paths: the cross-path seeded-draw parity the tests
+    pin depends on every path walking the identical chain."""
+
+    def mk(sd):
+        k, sub = jax.random.split(jax.random.key(sd))
+        return jax.random.key_data(k), jax.random.key_data(sub)
+
+    return jax.vmap(mk)(seeds)
+
+
+def key_chain_split(row_keys: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Advance per-row chains one step: raw ``[B, 2]`` key data → ``(new
+    [B,2], subs [B,2])`` — the monolith's per-decode-step split."""
+
+    def spl(kd):
+        k, sub = jax.random.split(jax.random.wrap_key_data(kd))
+        return jax.random.key_data(k), jax.random.key_data(sub)
+
+    return jax.vmap(spl)(row_keys)
+
+
 def sp_sample_rows(
     cfg: ModelConfig,
     head: HeadParams,  # local view

@@ -47,7 +47,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models.cache import KVCache, POS_SENTINEL
 from ..models.config import ModelConfig
 from ..ops.sampling import is_stop as _is_stop
-from .head import head_specs, local_view, psum_from, sp_embed, sp_next_token
+from .head import (
+    head_specs, key_chain_split, local_view, psum_from, seed_chain_init,
+    sp_embed, sp_next_token, sp_sample_rows,
+)
 from .mesh import PIPE_AXIS
 from .pipeline import (
     check_stage_shapes,
@@ -66,7 +69,8 @@ class InterleavedResult(NamedTuple):
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "cfg", "mesh", "num_stages", "max_new_tokens", "capacity", "cache_dtype"
+        "cfg", "mesh", "num_stages", "max_new_tokens", "capacity",
+        "cache_dtype", "top_k", "sampling",
     ),
 )
 def _interleaved_jit(
@@ -78,10 +82,14 @@ def _interleaved_jit(
     prompts: jnp.ndarray,  # [M, S] right-padded, M == num_stages * Bs rows
     prompt_len: jnp.ndarray,  # [M]
     slot_valid: jnp.ndarray,  # [M] bool — False for padding rows
+    temperature: jnp.ndarray,  # [M] f32; <= 0 → greedy for that row
+    seeds: jnp.ndarray,  # [M] int32 per-row sampling seeds
     num_stages: int,
     max_new_tokens: int,
     capacity: int,
     cache_dtype,
+    top_k: int,
+    sampling: bool,
 ):
     fns = model_fns(cfg)
     M, S = prompts.shape
@@ -91,7 +99,8 @@ def _interleaved_jit(
     ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
     last = num_stages - 1
 
-    def body(stage_layers, layer_mask, head_params, prompts, prompt_len, slot_valid):
+    def body(stage_layers, layer_mask, head_params, prompts, prompt_len,
+             slot_valid, temperature, seeds):
         layers = jax.tree.map(lambda a: a[0], stage_layers)
         lmask = layer_mask[0]
         hd = local_view(head_params)
@@ -118,7 +127,16 @@ def _interleaved_jit(
             h, (prompt_len - 1)[:, None, None], axis=1
         )[:, 0]
         h_last = psum_from(h_last, 0)
-        tok0 = sp_next_token(cfg, hd, h_last)  # [M], replicated
+        if sampling:
+            # per-row key chains mirror the monolith's (key(seed) → split →
+            # sample) — the SAME shared helpers as the serve path
+            row_keys, subs = seed_chain_init(seeds)  # [M, 2] each
+            tok0 = sp_sample_rows(
+                cfg, hd, h_last, subs, temperature, top_k, num_stages
+            )
+        else:
+            row_keys = jnp.zeros((M, 2), jnp.uint32)
+            tok0 = sp_next_token(cfg, hd, h_last)  # [M], replicated
 
         out = jnp.zeros((M, total), jnp.int32)
         out = jax.lax.dynamic_update_slice(out, prompts, (0, 0))
@@ -154,6 +172,7 @@ def _interleaved_jit(
             lengths=lengths,
             pos_slots=pos_slots,
             write_off=write_off,
+            rng=row_keys,
             m=jnp.zeros((), jnp.int32),
         )
 
@@ -212,8 +231,18 @@ def _interleaved_jit(
             valid_done = m >= last
 
             h_done = psum_from(h_new[:, 0], last)  # [Bs, H]
-            nxt = sp_next_token(cfg, hd, h_done)  # [Bs], replicated
             done_rows = jax.lax.dynamic_slice_in_dim(s["done"], rowd, Bs)
+            if sampling:
+                rng_rows = jax.lax.dynamic_slice_in_dim(
+                    s["rng"], rowd, Bs, axis=0
+                )
+                new_keys, subs = key_chain_split(rng_rows)
+                temp_rows = jax.lax.dynamic_slice_in_dim(temperature, rowd, Bs)
+                nxt = sp_sample_rows(
+                    cfg, hd, h_done, subs, temp_rows, top_k, num_stages
+                )
+            else:
+                nxt = sp_next_token(cfg, hd, h_done)  # [Bs], replicated
             nxt = jnp.where(done_rows, 0, nxt)
 
             len_rows = jax.lax.dynamic_slice_in_dim(s["lengths"], rowd, Bs)
@@ -227,6 +256,12 @@ def _interleaved_jit(
             done = s["done"].at[row_ids].set(
                 done_rows | (commit & _is_stop(cfg, nxt))
             )
+            if sampling:
+                rng = s["rng"].at[row_ids].set(
+                    jnp.where(commit[:, None], new_keys, rng_rows)
+                )
+            else:
+                rng = s["rng"]
 
             # re-embed the fresh tokens (vocab-parallel, replicated result);
             # only the last stage sends them around the ring
@@ -248,6 +283,7 @@ def _interleaved_jit(
                 lengths=lengths,
                 pos_slots=pos_slots,
                 write_off=write_off,
+                rng=rng,
                 m=m + 1,
             )
 
@@ -264,10 +300,13 @@ def _interleaved_jit(
             P(),
             P(),
             P(),
+            P(),
+            P(),
         ),
         out_specs=(P(), P()),
         check_vma=False,
-    )(stage_layers, layer_masks, head_params, prompts, prompt_len, slot_valid)
+    )(stage_layers, layer_masks, head_params, prompts, prompt_len, slot_valid,
+      temperature, seeds)
     return out, lengths
 
 
@@ -284,10 +323,16 @@ def interleaved_generate(
     capacity: Optional[int] = None,
     batch_per_slot: Optional[int] = None,
     cache_dtype=jnp.bfloat16,
+    temperature=0.0,  # scalar or per-request [R]; <= 0 → greedy
+    top_k: int = 0,
+    seeds=None,  # per-request sampling seeds [R] (default zeros)
 ) -> InterleavedResult:
     """Generate for up to ``num_stages * batch_per_slot`` requests
     concurrently, pipeline full. ``batch_per_slot`` defaults to the smallest
-    value that fits all R requests."""
+    value that fits all R requests. Sampling is per-row: request r with
+    ``temperature[r] > 0`` draws the B=1 monolithic ``generate(...,
+    temperature, top_k, seed=seeds[r])`` tokens exactly (the same key-chain
+    contract as the serve path)."""
     prompts = jnp.asarray(prompts, jnp.int32)
     if prompts.ndim == 1:
         prompts = prompts[None]
@@ -319,6 +364,15 @@ def interleaved_generate(
             [prompt_len, jnp.ones((M - R,), jnp.int32)], axis=0
         )
 
+    temps = np.zeros((M,), np.float32)
+    temps[:R] = np.broadcast_to(np.asarray(temperature, np.float32), (R,))
+    seed_arr = np.zeros((M,), np.int32)
+    if seeds is not None:
+        seed_arr[:R] = np.broadcast_to(np.asarray(seeds, np.int32), (R,))
+    # top_k alone cannot change an argmax, so all-greedy batches compile the
+    # plain greedy program regardless of top_k
+    sampling = bool(np.any(temps > 0))
+
     out, lengths = _interleaved_jit(
         cfg,
         mesh,
@@ -328,9 +382,13 @@ def interleaved_generate(
         prompts,
         prompt_len,
         jnp.asarray(slot_valid),
+        jnp.asarray(temps),
+        jnp.asarray(seed_arr),
         num_stages,
         max_new_tokens,
         capacity,
         cache_dtype,
+        int(top_k),
+        sampling,
     )
     return InterleavedResult(np.asarray(out)[:R], np.asarray(lengths)[:R])

@@ -44,8 +44,8 @@ from ..models.cache import KVCache, POS_SENTINEL
 from ..models.config import ModelConfig
 from ..ops.sampling import is_stop as _is_stop
 from .head import (
-    head_specs, local_view, psum_from, sp_embed, sp_next_token,
-    sp_sample_rows,
+    head_specs, key_chain_split, local_view, psum_from, seed_chain_init,
+    sp_embed, sp_next_token, sp_sample_rows,
 )
 from .mesh import PIPE_AXIS
 from .pipeline import model_fns, ring_chain
@@ -219,11 +219,7 @@ def serve_admit(
         # Per-row key chains mirror the monolith's (key(seed) → split →
         # sample), so a seeded temperature>0 request draws the monolith's
         # B=1 tokens exactly (r2 weak #8).
-        def mk(s):
-            k, sub = jax.random.split(jax.random.key(s))
-            return jax.random.key_data(k), jax.random.key_data(sub)
-
-        row_keys, subs = jax.vmap(mk)(seeds)  # [Bs, 2] each
+        row_keys, subs = seed_chain_init(seeds)  # [Bs, 2] each
         tok0 = sp_sample_rows(
             cfg, hd, h_last, subs, temperature, top_k, num_stages
         )  # [Bs] replicated
@@ -606,12 +602,7 @@ def serve_chunk(
                 rng_rows = jax.lax.dynamic_slice_in_dim(
                     s.rng, rowd, Bs, axis=0
                 )
-
-                def spl(kd):
-                    k, sub = jax.random.split(jax.random.wrap_key_data(kd))
-                    return jax.random.key_data(k), jax.random.key_data(sub)
-
-                new_keys, subs = jax.vmap(spl)(rng_rows)
+                new_keys, subs = key_chain_split(rng_rows)
                 temp_rows = jax.lax.dynamic_slice_in_dim(s.temp, rowd, Bs)
                 nxt = sp_sample_rows(
                     cfg, hd, h_done, subs, temp_rows, top_k, num_stages

@@ -218,6 +218,9 @@ class PipelineEngine:
         *,
         prompt_len=None,
         capacity: Optional[int] = None,
+        temperature=0.0,
+        top_k: int = 0,
+        seeds=None,
     ):
         """Serve up to ``num_stages`` requests concurrently with the
         interleaved schedule — all stages busy every microstep (the
@@ -238,6 +241,9 @@ class PipelineEngine:
             prompt_len=prompt_len,
             capacity=capacity,
             cache_dtype=self.cache_dtype,
+            temperature=temperature,
+            top_k=top_k,
+            seeds=seeds,
         )
 
     def generate_text(self, prompt: str, max_new_tokens: int = 128) -> str:

@@ -123,10 +123,11 @@ class PipelineServer:
         # top-k is server-level (a static program parameter — per-request
         # values would recompile serve_chunk); temperature/seed are per-request.
         # The decode program compiles greedy-only until the first sampled
-        # request arrives (the sampler costs ~20% steady-state throughput),
-        # then sticks with the sampling variant.
+        # request arrives (the sampler costs ~20% steady-state throughput;
+        # top_k alone cannot change an argmax), then sticks with the
+        # sampling variant.
         self.top_k = top_k
-        self._sampling = top_k > 0
+        self._sampling = False
         # chunked admission (r2 weak #4): prompts longer than this are
         # prefilled in bounded chunks with decode cycles interleaved, so a
         # long admission never stalls live streams. None → one-shot admit.
