@@ -172,7 +172,10 @@ def bench_serve(on_tpu, cfg, params_np, jax, jnp):
         "serve_tok_s_llama3.2-3b_1stage" if on_tpu else "serve_tok_s_tiny_cpu"
     )
     if on_tpu:
-        batch_per_slot, capacity, chunk_cycles = 4, 512, 8
+        # chunk_cycles=16: each step() ends in a host fetch, and on a
+        # tunneled chip that sync is ~100 ms — coarser chunks amortize it
+        # (the serve numbers are otherwise tunnel-RTT noise, 60-85 tok/s)
+        batch_per_slot, capacity, chunk_cycles = 4, 512, 16
         prompt_len, max_new = 32, 256
     else:
         batch_per_slot, capacity, chunk_cycles = 2, 64, 2
@@ -210,8 +213,10 @@ def bench_serve(on_tpu, cfg, params_np, jax, jnp):
 def bench_pallas(on_tpu, jax, jnp):
     """Fused flash-attention kernel vs the XLA path: prefill latency at
     S=C=2048, llama3-8b head geometry (32 q / 8 kv / D=128), bf16, plus an
-    on-chip numeric cross-check. Timing chains each iteration's output into
-    the next call's operand so the device can't overlap the repeats."""
+    on-chip numeric cross-check. Timed with a DEVICE-SIDE fori_loop over
+    chained iterations (one dispatch): host-side per-call timing through the
+    axon tunnel is dominated by ~100 ms sync round trips and jitter, which
+    buried the kernel time."""
     from llm_sharding_tpu.ops.attention import cached_attention
     from llm_sharding_tpu.ops.flash_attention import flash_attention
 
@@ -238,15 +243,31 @@ def bench_pallas(on_tpu, jax, jnp):
     if diff > 0.05:  # bf16 at unit-normal scale: one-ulp-level agreement
         raise AssertionError(f"pallas/XLA mismatch on chip: max|d|={diff}")
 
-    def timed(fn, n=10):
-        x = q
-        fn(x, k, v, qpos, kvpos).block_until_ready()  # warm
+    def make_loop(fn):
+        @jax.jit
+        def loop(x, n):  # traced trip count: ONE compile per fn
+            return jax.lax.fori_loop(
+                0, n, lambda i, x: fn(x, k, v, qpos, kvpos), x
+            )
+
+        return loop
+
+    def dev_loop(loop, n):
         t0 = time.perf_counter()
-        for _ in range(n):
-            # chain: feed the output back in so iterations serialize
-            x = fn(x, k, v, qpos, kvpos)
-        x.block_until_ready()
-        return (time.perf_counter() - t0) / n
+        loop(q, n).block_until_ready()
+        return time.perf_counter() - t0
+
+    def timed(fn, n1=50, n2=250, reps=3):
+        """Difference method subtracts the one-time dispatch/sync cost; the
+        tunnel RTT jitters by tens of ms, so the work delta (n2-n1 kernels)
+        must dwarf it and the median of several estimates is reported."""
+        loop = make_loop(fn)
+        dev_loop(loop, 1)  # compile + warm
+        ests = sorted(
+            (dev_loop(loop, n2) - dev_loop(loop, n1)) / (n2 - n1)
+            for _ in range(reps)
+        )
+        return ests[reps // 2]
 
     t_pallas = timed(flash_attention)
     t_xla = timed(cached_attention)

@@ -118,3 +118,26 @@ def test_flash_gqa_fold_llama3_geometry():
     want = cached_attention(q, k, v, q_pos, kv_pos)
     got = flash_attention(q, k, v, q_pos, kv_pos, interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_flash_multi_block_recurrence_interpret(monkeypatch):
+    """Force multiple query AND KV blocks at tiny shapes (the production
+    512/1024 blocks mean small interpret tests otherwise run a single block,
+    never exercising the online-softmax cross-block recurrence, the acc/m/l
+    init-correct-finish phases, or the q/kv pad paths)."""
+    from llm_sharding_tpu.ops import flash_attention as fa
+
+    monkeypatch.setattr(fa, "BLOCK_Q", 16)
+    monkeypatch.setattr(fa, "BLOCK_K", 32)
+
+    B, S, C, Nh, Nkv, D = 2, 37, 70, 4, 2, 8  # ragged: pads both axes
+    rng = np.random.default_rng(12)
+    q = jnp.asarray(rng.normal(size=(B, S, Nh, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, C, Nkv, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, C, Nkv, D)), jnp.float32)
+    qpos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32) + 33, (B, S))
+    kvpos = jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32), (B, C))
+
+    got = fa.flash_attention(q, k, v, qpos, kvpos, interpret=True)
+    want = cached_attention(q, k, v, qpos, kvpos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
