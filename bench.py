@@ -257,10 +257,11 @@ def bench_pallas(on_tpu, jax, jnp):
         loop(q, n).block_until_ready()
         return time.perf_counter() - t0
 
-    def timed(fn, n1=50, n2=250, reps=3):
+    def timed(fn, n1=50, n2=450, reps=5):
         """Difference method subtracts the one-time dispatch/sync cost; the
-        tunnel RTT jitters by tens of ms, so the work delta (n2-n1 kernels)
-        must dwarf it and the median of several estimates is reported."""
+        tunnel RTT jitters by tens of ms, so the work delta (n2-n1 kernels —
+        ≥120 ms even for the sub-ms fused kernel) must dwarf it, and the
+        median of several estimates is reported."""
         loop = make_loop(fn)
         dev_loop(loop, 1)  # compile + warm
         ests = sorted(

@@ -48,10 +48,12 @@ from .attention import cached_attention
 
 # Block sizes from an on-chip sweep (v5e, llama3-8b geometry, S=C=2048,
 # device-side fori_loop timing — host timing through the tunnel is
-# RTT-jitter-bound): {128,256,512}x{512,1024,2048} gave 0.31 ms at
-# (512, 1024) and (512, 2048) vs 1.20 ms at the old (256, 512) and 1.95 ms
-# for the XLA path. 1024 keeps the per-step K/V VMEM footprint at 0.5 MB
-# and leaves room for future fully-masked-block skipping.
+# RTT-jitter-bound): {128,256,512}x{512,1024,2048} ranked (512, 1024) ≈
+# (512, 2048) fastest, ~2x over the old (256, 512). With the bench's
+# higher-precision difference method the kernel measures ~0.62 ms vs
+# ~2.2 ms for the XLA path (3.5x, the figure README cites). 1024 keeps the
+# per-step K/V VMEM footprint at 0.5 MB and leaves room for future
+# fully-masked-block skipping.
 BLOCK_Q = 512
 BLOCK_K = 1024
 NEG_INF = -1e30  # python float: jnp constants can't be captured by kernels

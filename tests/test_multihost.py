@@ -93,6 +93,29 @@ _WORKER = textwrap.dedent(
     want = generate(cfg, params, prompts, 8, cache_dtype=jnp.float32)
     assert np.array_equal(res3.tokens, want.tokens), "hybrid dp x pp mismatch"
 
+    # --- continuous-batching server across both processes ---
+    # every process runs the same host loop in lockstep (the multi-controller
+    # convention); the serve state takes the put_global assembly path
+    eng2 = PipelineEngine(cfg, params, num_stages=4, cache_dtype=jnp.float32)
+    srv = eng2.serve(capacity=64)
+    pa = np.array([5, 9, 2, 14], np.int32)
+    pb = np.array([7, 3, 1], np.int32)
+    ra = srv.submit(pa, 8)
+    srv.step()
+    rb = srv.submit(pb, 6, temperature=0.8, seed=13)  # joins mid-decode
+    srv.run_until_idle()
+    oa = generate(cfg, params, pa[None], 8, cache_dtype=jnp.float32)
+    assert ra.tokens == [
+        int(x) for x in oa.tokens[0][len(pa): int(oa.lengths[0])]
+    ], "multihost serve greedy mismatch"
+    ob = generate(
+        cfg, params, pb[None], 6, temperature=0.8, seed=13,
+        cache_dtype=jnp.float32,
+    )
+    assert rb.tokens == [
+        int(x) for x in ob.tokens[0][len(pb): int(ob.lengths[0])]
+    ], "multihost serve sampled mismatch"
+
     print(f"MULTIHOST-OK p{{pid}}", flush=True)
     """
 ).format(repo=REPO)
