@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from ..ops.flash_attention import attention_step
 from ..ops.norms import layer_norm
-from ..ops.quant import qmatmul
+from ..ops.quant import out_dim, qmatmul
 from .cache import KVCache
 from .config import ModelConfig
 from .stack import scan_layers
@@ -80,18 +80,26 @@ def decoder_layer(
     cfg: ModelConfig,
     p: Params,
     h: jnp.ndarray,  # [B, S, H]
-    k_row: jnp.ndarray,  # [B, C, Nh, D]
+    k_row: jnp.ndarray,  # [B, C, Nh_local, D]
     v_row: jnp.ndarray,
     positions: jnp.ndarray,  # [B, S]
     kv_positions: jnp.ndarray,  # [B, C]
     length: jnp.ndarray,
+    tp_axis=None,
 ):
+    """One GPT-2 block. Under explicit tensor parallelism (``tp_axis`` set)
+    each device holds a column slice of the PERMUTED fused qkv (layout
+    [q_shard | k_shard | v_shard] per shard — ``parallel/tensor.
+    prepare_gpt2_tp_layers``), so the local three-way split below yields the
+    local head slice; the two row-parallel products (w_proj / w_out) psum,
+    and their biases are added once, after the psum."""
     B, S, H = h.shape
-    Nh = cfg.num_attention_heads
     D = cfg.head_dim_
+    # local head count from the (possibly TP-sharded) fused weight
+    Nh = out_dim(p["w_qkv"]) // (3 * D)
 
     x = layer_norm(h, p["ln1_w"], p["ln1_b"], cfg.layer_norm_epsilon)
-    qkv = qmatmul(x, p["w_qkv"]) + p["b_qkv"]  # [B, S, 3H]
+    qkv = qmatmul(x, p["w_qkv"]) + p["b_qkv"]  # [B, S, 3·Nh·D] (local)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(B, S, Nh, D)
     k = k.reshape(B, S, Nh, D)
@@ -101,11 +109,20 @@ def decoder_layer(
     v_row = jax.lax.dynamic_update_slice(v_row, v.astype(v_row.dtype), (0, length, 0, 0))
 
     attn = attention_step(q, k_row, v_row, positions, kv_positions, length)
-    h = h + qmatmul(attn.reshape(B, S, H), p["w_proj"]) + p["b_proj"]
+    attn_out = qmatmul(attn.reshape(B, S, Nh * D), p["w_proj"])
+    if tp_axis is not None:
+        attn_out = jax.lax.psum(attn_out, tp_axis)
+    h = h + attn_out + p["b_proj"]
 
     x = layer_norm(h, p["ln2_w"], p["ln2_b"], cfg.layer_norm_epsilon)
-    mlp = jax.nn.gelu((qmatmul(x, p["w_fc"]) + p["b_fc"]).astype(jnp.float32), approximate=True)
-    h = h + qmatmul(mlp.astype(x.dtype), p["w_out"]) + p["b_out"]
+    mlp = jax.nn.gelu(
+        (qmatmul(x, p["w_fc"]) + p["b_fc"]).astype(jnp.float32),
+        approximate=True,
+    )
+    mlp_out = qmatmul(mlp.astype(x.dtype), p["w_out"])
+    if tp_axis is not None:
+        mlp_out = jax.lax.psum(mlp_out, tp_axis)
+    h = h + mlp_out + p["b_out"]
     return h, k_row, v_row
 
 
@@ -118,14 +135,10 @@ def forward_layers(
     layer_mask: Optional[jnp.ndarray] = None,
     tp_axis: Optional[str] = None,
 ) -> tuple[jnp.ndarray, KVCache]:
-    if tp_axis is not None:
-        raise NotImplementedError(
-            "explicit TP inside gpt2 stages (fused qkv) is not implemented; "
-            "llama only"
-        )
-
     def apply(p, h, k_row, v_row, kv_pos, length):
-        return decoder_layer(cfg, p, h, k_row, v_row, positions, kv_pos, length)
+        return decoder_layer(
+            cfg, p, h, k_row, v_row, positions, kv_pos, length, tp_axis
+        )
 
     return scan_layers(layers, h, cache, positions, apply, layer_mask)
 

@@ -100,15 +100,22 @@ def mesh_axis_sizes(mesh: Mesh) -> tuple[int, int, int]:
 def stage_layer_specs(cfg: ModelConfig, tp: int):
     """shard_map in_specs for the [num_stages, Lp, ...] stage arrays: pipe on
     the leading axis; with tensor parallelism, megatron column/row sharding on
-    the weight dims (specs from ``tensor.llama_tp_specs`` shifted under the
-    two leading stack axes)."""
+    the weight dims (specs from ``tensor.*_tp_specs`` shifted under the two
+    leading stack axes). gpt2's fused qkv is column-permuted by
+    ``pipeline_generate`` itself so each shard's slice is a head-aligned
+    (q, k, v) triple."""
     if tp == 1:
         return P(PIPE_AXIS)  # pytree-prefix spec: applies to every leaf
-    if cfg.model_type != "llama":
-        raise NotImplementedError("pp×tp: llama only")
-    from .tensor import llama_tp_specs
+    if cfg.model_type == "llama":
+        from .tensor import llama_tp_specs
 
-    per_leaf = llama_tp_specs(stacked=False)["layers"]
+        per_leaf = llama_tp_specs(stacked=False)["layers"]
+    elif cfg.model_type == "gpt2":
+        from .tensor import gpt2_tp_specs
+
+        per_leaf = gpt2_tp_specs(stacked=False)["layers"]
+    else:
+        raise NotImplementedError(f"pp×tp: {cfg.model_type!r} unsupported")
     return {k: P(PIPE_AXIS, None, *s) for k, s in per_leaf.items()}
 
 
@@ -381,6 +388,13 @@ def pipeline_generate(
                 "tensor parallelism over int8-quantized weights is not "
                 "supported yet (QTensor leaves need per-component specs)"
             )
+        if cfg.model_type == "gpt2":
+            # fused-qkv column permutation happens HERE, not as a caller
+            # precondition — callers pass raw layers and can neither forget
+            # nor double-apply it
+            from .tensor import permute_gpt2_tp_layers
+
+            stage_layers = permute_gpt2_tp_layers(stage_layers, tp)
     if B % dp != 0:
         raise ValueError(f"batch {B} not divisible by data-parallel size {dp}")
 

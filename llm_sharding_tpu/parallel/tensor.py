@@ -61,6 +61,66 @@ def llama_tp_specs(stacked: bool = True) -> dict[str, P]:
     }
 
 
+def gpt2_tp_specs(stacked: bool = True) -> dict[str, P]:
+    """PartitionSpecs for (layer-stacked) gpt2 params over TENSOR_AXIS.
+
+    Column-parallel weights carry column-parallel biases; row-parallel
+    matmuls (w_proj / w_out) psum first and add their bias once, replicated
+    (see ``models/gpt2.decoder_layer``). For the EXPLICIT shard_map path the
+    fused qkv weight/bias must be column-PERMUTED first so each shard's
+    slice is [q_shard | k_shard | v_shard] — ``permute_gpt2_qkv``; the GSPMD
+    path needs no permutation (global semantics, XLA reshards)."""
+    L = (None,) if stacked else ()
+    col = P(*L, None, TENSOR_AXIS)
+    row = P(*L, TENSOR_AXIS, None)
+    col_b = P(*L, TENSOR_AXIS)
+    rep = P()
+    return {
+        "layers": {
+            "ln1_w": rep, "ln1_b": rep,
+            "w_qkv": col, "b_qkv": col_b,
+            "w_proj": row, "b_proj": rep,
+            "ln2_w": rep, "ln2_b": rep,
+            "w_fc": col, "b_fc": col_b,
+            "w_out": row, "b_out": rep,
+        },
+        "embed": rep,
+        "pos_embed": rep,
+        "final_norm": rep,
+        "final_norm_bias": rep,
+        "lm_head": P(None, TENSOR_AXIS),  # untied heads are model-supported
+    }
+
+
+def qkv_perm_indices(h3: int, tp: int) -> np.ndarray:
+    """Column permutation for a fused-qkv last axis [q | k | v] →
+    [q_0 k_0 v_0 | q_1 k_1 v_1 | ...] so a contiguous 1/tp slice is a
+    head-aligned (q, k, v) triple — what the explicit shard_map TP path
+    splits locally (``models/gpt2.decoder_layer``). Head-aligned because
+    each third is sliced in tp equal chunks and head boundaries divide them
+    (validate_tp guarantees heads % tp == 0). Applied INSIDE
+    ``pipeline_generate`` (device-side ``jnp.take``) — callers pass raw
+    layers and can neither forget nor double-apply the permutation."""
+    H = h3 // 3
+    Hl = H // tp
+    idx = []
+    for t in range(tp):
+        for blk in range(3):
+            start = blk * H + t * Hl
+            idx.extend(range(start, start + Hl))
+    return np.asarray(idx, np.int32)
+
+
+def permute_gpt2_tp_layers(layers: dict, tp: int) -> dict:
+    """Permute the fused qkv weight + bias for explicit TP; other leaves
+    pass through. Device-side gather — works on numpy or jax arrays."""
+    idx = qkv_perm_indices(int(layers["b_qkv"].shape[-1]), tp)
+    out = dict(layers)
+    out["w_qkv"] = jnp.take(jnp.asarray(layers["w_qkv"]), idx, axis=-1)
+    out["b_qkv"] = jnp.take(jnp.asarray(layers["b_qkv"]), idx, axis=-1)
+    return out
+
+
 def validate_tp(cfg: ModelConfig, tp: int) -> None:
     for name, val in (
         ("num_attention_heads", cfg.num_attention_heads),
@@ -72,11 +132,17 @@ def validate_tp(cfg: ModelConfig, tp: int) -> None:
 
 
 def shard_params_tp(cfg: ModelConfig, params: Any, mesh: Mesh) -> Any:
-    """device_put params with megatron shardings; GSPMD does the rest."""
+    """device_put params with megatron shardings; GSPMD does the rest
+    (llama and gpt2 — no permutation needed here: jit keeps global
+    semantics and XLA reshards the fused qkv split as required)."""
     from ..ops.quant import is_quantized
 
-    if cfg.model_type != "llama":
-        raise NotImplementedError("TP specs: llama family first")
+    if cfg.model_type == "llama":
+        specs = llama_tp_specs()
+    elif cfg.model_type == "gpt2":
+        specs = gpt2_tp_specs()
+    else:
+        raise NotImplementedError(f"TP specs: {cfg.model_type!r} unsupported")
     if is_quantized(params["layers"]):
         raise NotImplementedError(
             "tensor parallelism over int8-quantized weights is not "
@@ -84,17 +150,17 @@ def shard_params_tp(cfg: ModelConfig, params: Any, mesh: Mesh) -> Any:
         )
     tp = mesh.shape[TENSOR_AXIS]
     validate_tp(cfg, tp)
-    specs = llama_tp_specs()
 
     def put(path_spec, leaf):
         return jax.device_put(leaf, NamedSharding(mesh, path_spec))
 
     out = {
-        "embed": put(specs["embed"], params["embed"]),
-        "final_norm": put(specs["final_norm"], params["final_norm"]),
-        "layers": {
-            k: put(specs["layers"][k], v) for k, v in params["layers"].items()
-        },
+        k: put(specs[k], v)
+        for k, v in params.items()
+        if k not in ("layers", "lm_head")
+    }
+    out["layers"] = {
+        k: put(specs["layers"][k], v) for k, v in params["layers"].items()
     }
     if "lm_head" in params:
         out["lm_head"] = put(specs["lm_head"], params["lm_head"])

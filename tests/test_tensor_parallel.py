@@ -72,3 +72,30 @@ def test_tp_indivisible_rejected():
     cfg = tiny_llama(num_key_value_heads=3, num_attention_heads=6)
     with pytest.raises(ValueError, match="divisible"):
         validate_tp(cfg, 4)
+
+
+def test_tp_gpt2_forward_matches_monolith():
+    """GSPMD TP for gpt2 (fused qkv): no permutation needed — jit keeps
+    global semantics; XLA reshards the split."""
+    from llm_sharding_tpu.models import gpt2
+    from llm_sharding_tpu.models.config import tiny_gpt2
+
+    cfg = tiny_gpt2()
+    params = gpt2.init_params(cfg, jax.random.key(9), dtype=jnp.float32)
+    B, S = 1, 10
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32)
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+
+    cache = init_cache(cfg, B, S, dtype=jnp.float32)
+    want, _ = gpt2.forward(cfg, params, jnp.asarray(ids), cache, positions)
+
+    mesh = tensor_mesh(2)
+    tp_params = shard_params_tp(cfg, params, mesh)
+    tp_cache = shard_cache_tp(init_cache(cfg, B, S, dtype=jnp.float32), mesh)
+    got, _ = jax.jit(
+        lambda p, i, c, pos: gpt2.forward(cfg, p, i, c, pos)
+    )(tp_params, jnp.asarray(ids), tp_cache, positions)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=3e-4, rtol=2e-3
+    )
