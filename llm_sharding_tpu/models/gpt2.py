@@ -76,23 +76,25 @@ def embed(params: Params, token_ids: jnp.ndarray, positions: jnp.ndarray) -> jnp
     return params["embed"][token_ids] + params["pos_embed"][positions]
 
 
-def decoder_layer(
+def attn_mlp_block(
     cfg: ModelConfig,
     p: Params,
     h: jnp.ndarray,  # [B, S, H]
-    k_row: jnp.ndarray,  # [B, C, Nh_local, D]
-    v_row: jnp.ndarray,
-    positions: jnp.ndarray,  # [B, S]
-    kv_positions: jnp.ndarray,  # [B, C]
-    length: jnp.ndarray,
+    attn_fn,  # (q[B,S,Nh,D], k, v) -> [B,S,Nh,D]
     tp_axis=None,
-):
-    """One GPT-2 block. Under explicit tensor parallelism (``tp_axis`` set)
-    each device holds a column slice of the PERMUTED fused qkv (layout
-    [q_shard | k_shard | v_shard] per shard — ``parallel/tensor.
-    prepare_gpt2_tp_layers``), so the local three-way split below yields the
-    local head slice; the two row-parallel products (w_proj / w_out) psum,
-    and their biases are added once, after the psum."""
+) -> jnp.ndarray:
+    """One GPT-2 block with the attention mechanism injected — the single
+    implementation behind the cached (pipeline/decode) path and the
+    ring-attention (context-parallel) path, mirroring
+    ``models/llama.attn_mlp_block``.
+
+    Under explicit tensor parallelism (``tp_axis`` set) each device holds a
+    column slice of the PERMUTED fused qkv (layout [q_shard | k_shard |
+    v_shard] per shard — applied by ``pipeline_generate`` via
+    ``parallel/tensor.permute_gpt2_tp_layers``), so the local three-way
+    split below yields the local head slice; the two row-parallel products
+    (w_proj / w_out) psum, and their biases are added once, after the psum.
+    """
     B, S, H = h.shape
     D = cfg.head_dim_
     # local head count from the (possibly TP-sharded) fused weight
@@ -105,10 +107,7 @@ def decoder_layer(
     k = k.reshape(B, S, Nh, D)
     v = v.reshape(B, S, Nh, D)
 
-    k_row = jax.lax.dynamic_update_slice(k_row, k.astype(k_row.dtype), (0, length, 0, 0))
-    v_row = jax.lax.dynamic_update_slice(v_row, v.astype(v_row.dtype), (0, length, 0, 0))
-
-    attn = attention_step(q, k_row, v_row, positions, kv_positions, length)
+    attn = attn_fn(q, k, v)
     attn_out = qmatmul(attn.reshape(B, S, Nh * D), p["w_proj"])
     if tp_axis is not None:
         attn_out = jax.lax.psum(attn_out, tp_axis)
@@ -123,7 +122,34 @@ def decoder_layer(
     if tp_axis is not None:
         mlp_out = jax.lax.psum(mlp_out, tp_axis)
     h = h + mlp_out + p["b_out"]
-    return h, k_row, v_row
+    return h
+
+
+def decoder_layer(
+    cfg: ModelConfig,
+    p: Params,
+    h: jnp.ndarray,  # [B, S, H]
+    k_row: jnp.ndarray,  # [B, C, Nh_local, D]
+    v_row: jnp.ndarray,
+    positions: jnp.ndarray,  # [B, S]
+    kv_positions: jnp.ndarray,  # [B, C]
+    length: jnp.ndarray,
+    tp_axis=None,
+):
+    rows = {}
+
+    def attn_fn(q, k, v):
+        k_r = jax.lax.dynamic_update_slice(
+            k_row, k.astype(k_row.dtype), (0, length, 0, 0)
+        )
+        v_r = jax.lax.dynamic_update_slice(
+            v_row, v.astype(v_row.dtype), (0, length, 0, 0)
+        )
+        rows["k"], rows["v"] = k_r, v_r
+        return attention_step(q, k_r, v_r, positions, kv_positions, length)
+
+    h = attn_mlp_block(cfg, p, h, attn_fn, tp_axis)
+    return h, rows["k"], rows["v"]
 
 
 def forward_layers(

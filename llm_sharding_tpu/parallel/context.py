@@ -26,26 +26,32 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.cache import POS_SENTINEL
 from ..models.config import ModelConfig
-from ..ops.norms import rms_norm
+from ..ops.norms import layer_norm, rms_norm
 from ..ops.ring_attention import ring_attention
 from ..ops.rope import rope_cos_sin
 from .mesh import SEQ_AXIS
 
 
 def _ctx_layer(cfg: ModelConfig, p: Any, h, cos, sin, q_pos, kv_pos):
-    """One llama decoder layer with ring attention over the seq axis — shares
-    ``models/llama.py:attn_mlp_block``; only the attention mechanism differs.
-    Returns the layer's (RoPE'd) K/V chunk alongside the hidden state so the
-    prefill can assemble a decode cache (``context_prefill_cache``)."""
-    from ..models.llama import attn_mlp_block
-
+    """One decoder layer (llama or gpt2) with ring attention over the seq
+    axis — shares each family's ``attn_mlp_block``; only the attention
+    mechanism differs. Returns the layer's K/V chunk alongside the hidden
+    state so the prefill can assemble a decode cache
+    (``context_prefill_cache``)."""
     got = {}
 
     def attn_fn(q, k, v):
         got["k"], got["v"] = k, v
         return ring_attention(q, k, v, q_pos, kv_pos, SEQ_AXIS)
 
-    h = attn_mlp_block(cfg, p, h, cos, sin, attn_fn)
+    if cfg.model_type == "llama":
+        from ..models.llama import attn_mlp_block
+
+        h = attn_mlp_block(cfg, p, h, cos, sin, attn_fn)
+    else:  # gpt2: nothing positional inside the layers (wpe added at embed)
+        from ..models.gpt2 import attn_mlp_block
+
+        h = attn_mlp_block(cfg, p, h, attn_fn)
     return h, got["k"], got["v"]
 
 
@@ -68,12 +74,18 @@ def _context_prefill_jit(
     per-layer K/V chunks additionally when ``want_cache`` (the decode
     handoff). Returns ``logits`` or ``(logits, ks, vs)`` — the structure is
     switched by the static flag."""
-    if cfg.model_type != "llama":
-        raise NotImplementedError("context parallelism: llama family first")
+    if cfg.model_type not in ("llama", "gpt2"):
+        raise NotImplementedError(
+            f"context parallelism: {cfg.model_type!r} unsupported"
+        )
 
     def body(params, ids_chunk, pos_chunk, last_position):
-        h = params["embed"][ids_chunk]
-        cos, sin = rope_cos_sin(pos_chunk, cfg, dtype=jnp.float32)
+        if cfg.model_type == "llama":
+            h = params["embed"][ids_chunk]
+            cos, sin = rope_cos_sin(pos_chunk, cfg, dtype=jnp.float32)
+        else:  # gpt2: learned positions added at embed; sentinel pads clamp
+            h = params["embed"][ids_chunk] + params["pos_embed"][pos_chunk]
+            cos = sin = None
 
         def scan_body(h, p):
             h, k, v = _ctx_layer(cfg, p, h, cos, sin, pos_chunk, pos_chunk)
@@ -84,7 +96,13 @@ def _context_prefill_jit(
             return h, ys
 
         h, ys = jax.lax.scan(scan_body, h, params["layers"])
-        h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+        if cfg.model_type == "llama":
+            h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+        else:
+            h = layer_norm(
+                h, params["final_norm"], params["final_norm_bias"],
+                cfg.layer_norm_epsilon,
+            )
 
         def project(x):
             if "lm_head" in params:

@@ -391,10 +391,11 @@ def pipeline_generate(
         if cfg.model_type == "gpt2":
             # fused-qkv column permutation happens HERE, not as a caller
             # precondition — callers pass raw layers and can neither forget
-            # nor double-apply it
-            from .tensor import permute_gpt2_tp_layers
+            # nor double-apply it; memoized so repeated requests over the
+            # same stage arrays don't re-gather the weights
+            from .tensor import permute_gpt2_tp_layers_cached
 
-            stage_layers = permute_gpt2_tp_layers(stage_layers, tp)
+            stage_layers = permute_gpt2_tp_layers_cached(stage_layers, tp)
     if B % dp != 0:
         raise ValueError(f"batch {B} not divisible by data-parallel size {dp}")
 

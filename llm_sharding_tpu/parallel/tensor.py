@@ -66,10 +66,11 @@ def gpt2_tp_specs(stacked: bool = True) -> dict[str, P]:
 
     Column-parallel weights carry column-parallel biases; row-parallel
     matmuls (w_proj / w_out) psum first and add their bias once, replicated
-    (see ``models/gpt2.decoder_layer``). For the EXPLICIT shard_map path the
-    fused qkv weight/bias must be column-PERMUTED first so each shard's
-    slice is [q_shard | k_shard | v_shard] — ``permute_gpt2_qkv``; the GSPMD
-    path needs no permutation (global semantics, XLA reshards)."""
+    (see ``models/gpt2.attn_mlp_block``). For the EXPLICIT shard_map path
+    the fused qkv weight/bias must be column-PERMUTED first so each shard's
+    slice is [q_shard | k_shard | v_shard] — ``permute_gpt2_tp_layers``,
+    applied (and memoized) by ``pipeline_generate``; the GSPMD path needs no
+    permutation (global semantics, XLA reshards)."""
     L = (None,) if stacked else ()
     col = P(*L, None, TENSOR_AXIS)
     row = P(*L, TENSOR_AXIS, None)
@@ -119,6 +120,30 @@ def permute_gpt2_tp_layers(layers: dict, tp: int) -> dict:
     out["w_qkv"] = jnp.take(jnp.asarray(layers["w_qkv"]), idx, axis=-1)
     out["b_qkv"] = jnp.take(jnp.asarray(layers["b_qkv"]), idx, axis=-1)
     return out
+
+
+# Memo for the per-call permutation in pipeline_generate: keyed by the
+# IDENTITY of the w_qkv leaf (a strong ref to the original is held in the
+# entry, so an id can't be silently reused by a new array). Bounded — a
+# serving process re-calls with the same stage arrays every request.
+_PERMUTE_CACHE: dict = {}
+
+
+def permute_gpt2_tp_layers_cached(layers: dict, tp: int) -> dict:
+    key = (tp, id(layers["w_qkv"]))
+    hit = _PERMUTE_CACHE.get(key)
+    if hit is not None and hit[0] is layers["w_qkv"]:
+        out = dict(layers)
+        out.update(hit[1])
+        return out
+    permuted = permute_gpt2_tp_layers(layers, tp)
+    if len(_PERMUTE_CACHE) >= 4:
+        _PERMUTE_CACHE.clear()
+    _PERMUTE_CACHE[key] = (
+        layers["w_qkv"],
+        {"w_qkv": permuted["w_qkv"], "b_qkv": permuted["b_qkv"]},
+    )
+    return permuted
 
 
 def validate_tp(cfg: ModelConfig, tp: int) -> None:

@@ -174,3 +174,29 @@ def test_context_prefill_to_decode_sampled():
         cache_dtype=jnp.float32,
     )
     np.testing.assert_array_equal(got.tokens, want.tokens)
+
+
+def test_context_prefill_to_decode_gpt2():
+    """Context parallelism for the second model family: gpt2 ring-attention
+    prefill (learned positions added at embed; nothing positional inside the
+    layers) → decode from the assembled cache, token-exact vs the monolith."""
+    from llm_sharding_tpu.models import gpt2
+    from llm_sharding_tpu.models.config import tiny_gpt2
+    from llm_sharding_tpu.parallel.context import context_generate
+    from llm_sharding_tpu.runtime.generate import generate
+
+    cfg = tiny_gpt2(num_hidden_layers=4)
+    params = gpt2.init_params(cfg, jax.random.key(4), dtype=jnp.float32)
+    rng = np.random.default_rng(9)
+    ids = rng.integers(1, cfg.vocab_size, (2, 16)).astype(np.int32)
+    plen = np.array([13, 16], np.int32)
+
+    mesh = context_mesh(4)
+    got = context_generate(
+        cfg, mesh, params, ids, 10, prompt_len=plen, cache_dtype=jnp.float32
+    )
+    want = generate(
+        cfg, params, ids, 10, prompt_len=plen, cache_dtype=jnp.float32
+    )
+    np.testing.assert_array_equal(got.tokens, want.tokens)
+    np.testing.assert_array_equal(got.lengths, want.lengths)
