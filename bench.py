@@ -1,9 +1,11 @@
 """Benchmark suite: the judged surface, measured on the real chip.
 
-Prints ONE JSON line PER METRIC: {"metric", "value", "unit", "vs_baseline"}.
-The headline metric (3B single-chip greedy decode, the round-1/2 metric,
-unchanged methodology) is printed LAST so drivers that keep only the final
-line still record it.
+Prints ONE JSON line PER METRIC: {"metric", "value", "unit", "vs_baseline"},
+flushed as produced. The headline metric (3B single-chip greedy decode, the
+round-1/2 metric, unchanged methodology) is emitted FIRST — the full suite
+takes ~25 min on the tunneled chip (serve-program compiles dominate), and the
+anchor must survive a driver-side timeout; it is also repeated as the final
+line for drivers that keep only the last one.
 
 Metrics (VERDICT r2 next-#2, plus int8):
   a. decode_tok_s_llama2-7b_1chip   — largest 7B-family config on one chip
@@ -144,16 +146,29 @@ def bench_3b(on_tpu, jax, jnp):
         names = ("decode_tok_s_tiny_cpu_cbig", "decode_tok_s_tiny_cpu")
     params = llama.init_params(cfg, jax.random.key(0), dtype=jnp.bfloat16)
 
+    # ANCHOR FIRST: the no-regression metric must survive a driver timeout.
+    # Every sub-step reports under ITS OWN metric name — a post-anchor
+    # failure must never emit a contradictory error line under the anchor's
+    # name, and an anchor failure must not silently drop the other metrics.
+    tok_s = None
+    try:
+        tok_s = time_decode(
+            cfg, params, prompt_len, max_new, prompt_len + max_new, generate
+        )
+        emit(names[1], tok_s, "tokens/sec", tok_s / ANCHOR_TOK_S)
+    except Exception as e:  # noqa: BLE001 — report, keep benching
+        emit_error(names[1], "tokens/sec", e)
+
     try:
         tok_s_big = time_decode(cfg, params, prompt_len, max_new, big_c, generate)
         emit(names[0], tok_s_big, "tokens/sec", tok_s_big / ANCHOR_TOK_S)
-    except Exception as e:  # noqa: BLE001 — report, keep benching
+    except Exception as e:  # noqa: BLE001
         emit_error(names[0], "tokens/sec", e)
 
-    tok_s = time_decode(
-        cfg, params, prompt_len, max_new, prompt_len + max_new, generate
-    )
-    params_np = jax.tree.map(np.asarray, params)
+    try:
+        params_np = jax.tree.map(np.asarray, params)
+    except Exception:  # noqa: BLE001 — serve section will report
+        params_np = None
     params = bench_int8_variant(
         names[1], cfg, params, prompt_len, max_new, generate
     )
@@ -295,12 +310,8 @@ def main():
     nserve = "serve_tok_s_llama3.2-3b_1stage" if on_tpu else "serve_tok_s_tiny_cpu"
     npallas = "pallas_prefill_speedup_s2048" if on_tpu else "pallas_prefill_speedup_cpu"
 
-    try:
-        bench_7b(on_tpu, jax, jnp)
-    except Exception as e:  # noqa: BLE001
-        emit_error(n7b, "tokens/sec", e)
-        gc.collect()
-
+    # section order = survival priority under a driver-side timeout:
+    # 3B (anchor emitted immediately) → serve → 7B → pallas
     ret = None
     try:
         ret = bench_3b(on_tpu, jax, jnp)
@@ -308,25 +319,30 @@ def main():
         emit_error(n3b, "tokens/sec", e)
         gc.collect()
 
-    if ret is not None:
-        cfg, params_np, anchor_name, anchor_tok_s = ret
+    if ret is not None and ret[1] is not None:
         try:
-            bench_serve(on_tpu, cfg, params_np, jax, jnp)
+            bench_serve(on_tpu, ret[0], ret[1], jax, jnp)
         except Exception as e:  # noqa: BLE001
             emit_error(nserve, "tokens/sec", e)
-        del params_np
+        ret = (ret[0], None, ret[2], ret[3])  # drop the host params copy
         gc.collect()
     else:
         emit_error(nserve, "tokens/sec", "not attempted: 3B section failed")
+
+    try:
+        bench_7b(on_tpu, jax, jnp)
+    except Exception as e:  # noqa: BLE001
+        emit_error(n7b, "tokens/sec", e)
+        gc.collect()
 
     try:
         bench_pallas(on_tpu, jax, jnp)
     except Exception as e:  # noqa: BLE001
         emit_error(npallas, "x_speedup_vs_xla", e)
 
-    if ret is not None:
-        # headline LAST (drivers that keep one line keep this one)
-        emit(anchor_name, anchor_tok_s, "tokens/sec", anchor_tok_s / ANCHOR_TOK_S)
+    if ret is not None and ret[3] is not None:
+        # repeat the anchor LAST too (drivers that keep one line keep this)
+        emit(ret[2], ret[3], "tokens/sec", ret[3] / ANCHOR_TOK_S)
 
 
 if __name__ == "__main__":
