@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from ..ops.flash_attention import attention_step
 from ..ops.norms import layer_norm
-from ..ops.quant import out_dim, qmatmul
+from ..ops.quant import embed_rows, head_logits, out_dim, qmatmul, tied_logits
 from .cache import KVCache
 from .config import ModelConfig
 from .stack import scan_layers
@@ -72,8 +72,9 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
 
 def embed(params: Params, token_ids: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
     """wte[ids] + wpe[positions] (≙ the reference's bundled GPT embedding,
-    ``/root/reference/utils/model_sharder.py:100-108``)."""
-    return params["embed"][token_ids] + params["pos_embed"][positions]
+    ``/root/reference/utils/model_sharder.py:100-108``). The wte table may be
+    int8 row-quantized; wpe stays in the model dtype."""
+    return embed_rows(params["embed"], token_ids) + params["pos_embed"][positions]
 
 
 def attn_mlp_block(
@@ -172,9 +173,9 @@ def forward_layers(
 def final_logits(cfg: ModelConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
     h = layer_norm(h, params["final_norm"], params["final_norm_bias"], cfg.layer_norm_epsilon)
     if "lm_head" in params:
-        return (h @ params["lm_head"]).astype(jnp.float32)
+        return head_logits(h, params["lm_head"])
     # GPT-2 always ties lm_head to wte — contract against the table directly.
-    return jnp.einsum("bsh,vh->bsv", h, params["embed"]).astype(jnp.float32)
+    return tied_logits(h, params["embed"])
 
 
 def forward(

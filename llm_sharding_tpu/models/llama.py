@@ -27,7 +27,7 @@ import jax.numpy as jnp
 
 from ..ops.flash_attention import attention_step
 from ..ops.norms import rms_norm
-from ..ops.quant import out_dim, qmatmul
+from ..ops.quant import embed_rows, head_logits, out_dim, qmatmul, tied_logits
 from ..ops.rope import apply_rope, rope_cos_sin
 from .cache import KVCache
 from .config import ModelConfig
@@ -95,8 +95,9 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
 def embed(params: Params, token_ids: jnp.ndarray) -> jnp.ndarray:
     """Token embedding — the privacy boundary: requests enter the chain as
     embeddings, never raw token ids (≙ ``/root/reference/utils/node_worker.py:
-    215-223`` and README privacy note)."""
-    return params["embed"][token_ids]
+    215-223`` and README privacy note). The table may be int8 row-quantized
+    (``ops/quant.embed_rows``)."""
+    return embed_rows(params["embed"], token_ids)
 
 
 def attn_mlp_block(
@@ -214,8 +215,8 @@ def final_logits(cfg: ModelConfig, params: Params, h: jnp.ndarray) -> jnp.ndarra
     matmul; no duplicate vocab×hidden buffer in HBM)."""
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
     if "lm_head" in params:
-        return (h @ params["lm_head"]).astype(jnp.float32)
-    return jnp.einsum("bsh,vh->bsv", h, params["embed"]).astype(jnp.float32)
+        return head_logits(h, params["lm_head"])
+    return tied_logits(h, params["embed"])
 
 
 def forward(

@@ -36,6 +36,27 @@ class QTensor(NamedTuple):
     scale: jax.Array  # [.., out] per-output-channel scale (original dtype)
 
 
+class Int4QTensor(QTensor):
+    """Int4-quantized weight (≙ the reference's ``load_in_4bit``,
+    ``/root/reference/utils/model_sharder.py:28-45``): values in [-7, 7] with
+    absmax/7 scales. DEVICE residence is int8 (every QTensor code path —
+    qmatmul, scan stacking, shard_map specs — applies unchanged); the shard
+    store packs two values per byte on DISK (``utils/shard_store.py``), so
+    int4 stores are half the int8 size.
+
+    Why not int4 in HBM: measured on a v5e chip (jax 0.9.0), native ``S4``
+    arrays fail at dispatch (RecursionError in jit with any int4 operand),
+    and VPU nibble-unpacking of packed int8 (~4.2 ms per 400 MB, shifts +
+    interleave don't fuse into the dot) is slower than simply reading the
+    int8 bytes — int4-in-HBM loses to int8-in-HBM on this stack. The win
+    int4 keeps is the 2× smaller checkpoint (the reference's edge story:
+    shipping shards to devices), at int4 precision cost.
+
+    A NamedTuple subclass flattens/unflattens as its own pytree node type,
+    so tree ops rebuild Int4QTensor (not QTensor) and the store can detect
+    it at save time."""
+
+
 WeightLike = Union[jax.Array, np.ndarray, QTensor]
 
 
@@ -50,31 +71,39 @@ def _absmax_jit(w, contract_axis: int):
     return jnp.max(jnp.abs(w.astype(jnp.float32)), axis=contract_axis)
 
 
-def _q_impl(w, denom):
-    return jnp.round(w.astype(jnp.float32) / denom * 127.0).astype(jnp.int8)
+def _q_impl(w, denom, qmax):
+    return jnp.round(w.astype(jnp.float32) / denom * qmax).astype(jnp.int8)
 
 
-_q_jit = jax.jit(_q_impl)
-_q_donate_jit = jax.jit(_q_impl, donate_argnums=(0,))
+_q_jit = jax.jit(_q_impl, static_argnames=("qmax",))
+_q_donate_jit = jax.jit(_q_impl, donate_argnums=(0,), static_argnames=("qmax",))
 
 
-def quantize_tensor(w, contract_axis: int = -2, donate: bool = False) -> QTensor:
-    """Symmetric per-output-channel int8 quantization. ``contract_axis`` is
-    the axis a matmul contracts over (the scale must be constant along it to
+def quantize_tensor(
+    w, contract_axis: int = -2, donate: bool = False, bits: int = 8
+) -> QTensor:
+    """Symmetric per-output-channel quantization. ``contract_axis`` is the
+    axis a matmul contracts over (the scale must be constant along it to
     factor out of the dot). ``donate=True`` consumes ``w`` (device buffers
-    freed as the quantized copy is produced)."""
+    freed as the quantized copy is produced). ``bits`` is 8 (int8, qmax 127)
+    or 4 (``Int4QTensor``: values in [-7, 7], int8-resident, nibble-packed
+    on disk)."""
+    if bits not in (8, 4):
+        raise ValueError(f"bits must be 8 or 4, got {bits}")
+    qmax = 127.0 if bits == 8 else 7.0
     w = jnp.asarray(w)
     absmax = _absmax_jit(w, contract_axis=contract_axis)
-    scale = (absmax / 127.0).astype(w.dtype)
+    scale = (absmax / qmax).astype(w.dtype)
     denom = jnp.expand_dims(jnp.maximum(absmax, 1e-12), contract_axis)
-    q = (_q_donate_jit if donate else _q_jit)(w, denom)
+    q = (_q_donate_jit if donate else _q_jit)(w, denom, qmax=qmax)
     if donate:
         # block so the donated bf16 buffer is actually released before the
         # NEXT leaf's dispatch allocates its outputs — async dispatch
         # reserves output buffers ahead of execution, and at 7B scale the
         # un-released inputs + reserved outputs overrun HBM
         jax.block_until_ready(q)
-    return QTensor(q=q, scale=scale)
+    cls = QTensor if bits == 8 else Int4QTensor
+    return cls(q=q, scale=scale)
 
 
 def dequantize(t: QTensor, contract_axis: int = -2) -> jnp.ndarray:
@@ -82,9 +111,15 @@ def dequantize(t: QTensor, contract_axis: int = -2) -> jnp.ndarray:
     return t.q.astype(scale.dtype) * scale
 
 
+def base(w: WeightLike):
+    """The storage array of a maybe-quantized weight (for shape/ndim checks
+    and host-side slicing that must not dequantize)."""
+    return w.q if isinstance(w, QTensor) else w
+
+
 def out_dim(w: WeightLike) -> int:
     """Output (last-axis) size of a maybe-quantized weight."""
-    return (w.q if isinstance(w, QTensor) else w).shape[-1]
+    return base(w).shape[-1]
 
 
 def qmatmul(x: jnp.ndarray, w: WeightLike) -> jnp.ndarray:
@@ -97,13 +132,50 @@ def qmatmul(x: jnp.ndarray, w: WeightLike) -> jnp.ndarray:
     return x @ w
 
 
+def embed_rows(table: WeightLike, ids: jnp.ndarray) -> jnp.ndarray:
+    """Embedding lookup ``table[ids]`` accepting a raw ``[V, H]`` array or a
+    row-quantized QTensor (``scale`` per vocab row — the layout
+    ``quantize_params(quantize_head=True)`` produces). Gathers int8 rows and
+    dequantizes only the gathered rows."""
+    if isinstance(table, QTensor):
+        dt = table.scale.dtype
+        return table.q[ids].astype(dt) * table.scale[ids][..., None]
+    return table[ids]
+
+
+def head_logits(x: jnp.ndarray, w: WeightLike) -> jnp.ndarray:
+    """Untied-head projection ``x @ w`` in fp32. For a QTensor the per-column
+    scale is applied AFTER the fp32 cast — same precision contract as
+    ``tied_logits`` (a bf16 scale-multiply on final logits would collapse
+    sub-ulp logit differences and flip greedy/top-k ties vs the tied path)."""
+    if isinstance(w, QTensor):
+        prod = x @ w.q.astype(x.dtype)
+        return prod.astype(jnp.float32) * w.scale.astype(jnp.float32)
+    return (x @ w).astype(jnp.float32)
+
+
+def tied_logits(x: jnp.ndarray, table: WeightLike) -> jnp.ndarray:
+    """Tied-head projection ``x @ table.T`` (``einsum('...h,vh->...v')``) in
+    fp32, accepting a raw table or a row-quantized QTensor. The per-row scale
+    is constant along the contracted ``h`` axis, so it factors out of the dot
+    and the int8 table is consumed directly by the matmul — the tied vocab
+    table (788 MB bf16 at llama-3 geometry, read EVERY decode step by the
+    head) halves to int8 bytes."""
+    if isinstance(table, QTensor):
+        prod = jnp.einsum("...h,vh->...v", x, table.q.astype(x.dtype))
+        return prod.astype(jnp.float32) * table.scale.astype(jnp.float32)
+    return jnp.einsum("...h,vh->...v", x, table).astype(jnp.float32)
+
+
 # Layer-weight keys quantized by default: the matmul weights. Norm gains and
 # biases stay in the model dtype (tiny, precision-critical).
 LLAMA_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 GPT2_QUANT_KEYS = ("w_qkv", "w_out", "w_fc", "w_proj")
 
 
-def quantize_layer_params(layers: dict, keys=None, donate: bool = False) -> dict:
+def quantize_layer_params(
+    layers: dict, keys=None, donate: bool = False, bits: int = 8
+) -> dict:
     """Quantize a (stacked ``[L, in, out]``) layer pytree's matmul weights.
     Unknown keys pass through untouched. ``donate=True`` consumes each input
     leaf as its int8 replacement is produced (peak memory = params + one
@@ -114,7 +186,7 @@ def quantize_layer_params(layers: dict, keys=None, donate: bool = False) -> dict
     if not donate:
         return {
             k: (
-                quantize_tensor(v)
+                quantize_tensor(v, bits=bits)
                 if k in keys and not isinstance(v, QTensor)
                 else v
             )
@@ -127,19 +199,47 @@ def quantize_layer_params(layers: dict, keys=None, donate: bool = False) -> dict
     for k in list(layers.keys()):
         v = layers.pop(k)
         if k in keys and not isinstance(v, QTensor):
-            out[k] = quantize_tensor(v, donate=True)
+            out[k] = quantize_tensor(v, donate=True, bits=bits)
         else:
             out[k] = v
         del v
     return out
 
 
-def quantize_params(params: dict, keys=None, donate: bool = False) -> dict:
-    """Quantize a full model params pytree's layer weights (embedding /
-    head / norms stay in the model dtype — the vocab tables are already
-    vocab-sharded across the pipe axis, see parallel/head.py)."""
+def quantize_params(
+    params: dict,
+    keys=None,
+    donate: bool = False,
+    quantize_head: bool = False,
+    bits: int = 8,
+) -> dict:
+    """Quantize a full model params pytree's layer weights. Norms stay in the
+    model dtype (tiny, precision-critical).
+
+    ``quantize_head=True`` additionally quantizes the vocab tables — the
+    reference's ``load_in_8bit`` keeps lm_head fp16 (bitsandbytes default),
+    so this is opt-in: ``embed [V, H]`` gets per-ROW scales (valid for both
+    the gather lookup and the tied-head contraction over ``h``), an untied
+    ``lm_head [H, V]`` gets per-column scales (plain ``qmatmul``). At
+    llama-3.2-3b geometry the tied table is 788 MB bf16 — ~20% of ALL weight
+    bytes read per decode step once the layers are int8. ``pos_embed``
+    (gpt2 wpe) stays in the model dtype (small)."""
     out = dict(params)
-    out["layers"] = quantize_layer_params(params["layers"], keys, donate=donate)
+    out["layers"] = quantize_layer_params(
+        params["layers"], keys, donate=donate, bits=bits
+    )
+    if quantize_head:
+        for k, ax in (("embed", -1), ("lm_head", -2)):
+            if k not in out or isinstance(out[k], QTensor):
+                continue
+            v = out.pop(k)
+            if donate:
+                # drop the caller dict's reference too (same consumed-input
+                # contract as the layers path above) — a table still
+                # referenced elsewhere cannot actually be released
+                params.pop(k, None)
+            out[k] = quantize_tensor(v, contract_axis=ax, donate=donate, bits=bits)
+            del v
     return out
 
 

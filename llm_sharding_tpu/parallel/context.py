@@ -27,6 +27,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models.cache import POS_SENTINEL
 from ..models.config import ModelConfig
 from ..ops.norms import layer_norm, rms_norm
+from ..ops.quant import embed_rows, head_logits, tied_logits
 from ..ops.ring_attention import ring_attention
 from ..ops.rope import rope_cos_sin
 from .mesh import SEQ_AXIS
@@ -81,10 +82,13 @@ def _context_prefill_jit(
 
     def body(params, ids_chunk, pos_chunk, last_position):
         if cfg.model_type == "llama":
-            h = params["embed"][ids_chunk]
+            h = embed_rows(params["embed"], ids_chunk)
             cos, sin = rope_cos_sin(pos_chunk, cfg, dtype=jnp.float32)
         else:  # gpt2: learned positions added at embed; sentinel pads clamp
-            h = params["embed"][ids_chunk] + params["pos_embed"][pos_chunk]
+            h = (
+                embed_rows(params["embed"], ids_chunk)
+                + params["pos_embed"][pos_chunk]
+            )
             cos = sin = None
 
         def scan_body(h, p):
@@ -106,10 +110,8 @@ def _context_prefill_jit(
 
         def project(x):
             if "lm_head" in params:
-                return (x @ params["lm_head"]).astype(jnp.float32)
-            return jnp.einsum("...h,vh->...v", x, params["embed"]).astype(
-                jnp.float32
-            )
+                return head_logits(x, params["lm_head"])
+            return tied_logits(x, params["embed"])
 
         if full_logits:
             logits = project(h)

@@ -43,6 +43,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..models.config import ModelConfig
 from ..ops.norms import layer_norm, rms_norm
+from ..ops.quant import QTensor, base, embed_rows, head_logits, tied_logits
 from .mesh import PIPE_AXIS
 
 # Keys sharded over the vocab dimension (stacked [num_stages, ...] host-side).
@@ -67,27 +68,50 @@ def shard_head_host(
     Vs = vocab_shard_size(cfg.vocab_size, num_stages)
     Vp = Vs * num_stages
     pad = Vp - cfg.vocab_size
+
+    def shard_embed(v):  # [V, H] -> [S, V/S, H]
+        v = np.asarray(v)
+        if pad:
+            v = np.pad(v, ((0, pad), (0, 0)))
+        return v.reshape(num_stages, Vs, v.shape[1])
+
+    def shard_lm_head(v):  # [H, V] -> [S, H, V/S]
+        v = np.asarray(v)
+        if pad:
+            v = np.pad(v, ((0, 0), (0, pad)))
+        return np.transpose(v.reshape(v.shape[0], num_stages, Vs), (1, 0, 2))
+
+    def shard_scale(v):  # per-vocab-row/column scale [V] -> [S, V/S]
+        v = np.asarray(v)
+        if pad:
+            v = np.pad(v, ((0, pad),))
+        return v.reshape(num_stages, Vs)
+
     out: HeadParams = {}
     for k, v in head_host.items():
-        v = np.asarray(v)
         if k == "embed":
-            if pad:
-                v = np.pad(v, ((0, pad), (0, 0)))
-            out[k] = v.reshape(num_stages, Vs, v.shape[1])
+            # quantized tables (ops/quant.QTensor) shard like raw ones: the
+            # scale is per vocab row, so it splits along the same axis as q;
+            # type(v) keeps the Int4QTensor marker through the rebuild
+            if isinstance(v, QTensor):
+                out[k] = type(v)(q=shard_embed(v.q), scale=shard_scale(v.scale))
+            else:
+                out[k] = shard_embed(v)
         elif k == "lm_head":
-            if pad:
-                v = np.pad(v, ((0, 0), (0, pad)))
-            out[k] = np.transpose(
-                v.reshape(v.shape[0], num_stages, Vs), (1, 0, 2)
-            )
+            if isinstance(v, QTensor):
+                out[k] = type(v)(
+                    q=shard_lm_head(v.q), scale=shard_scale(v.scale)
+                )
+            else:
+                out[k] = shard_lm_head(v)
         else:
-            out[k] = v
+            out[k] = np.asarray(v)
     return out
 
 
 def is_sharded_head(head: HeadParams) -> bool:
     # rank check only — works on jax.Array / np.ndarray without transferring
-    return head["embed"].ndim == 3
+    return base(head["embed"]).ndim == 3
 
 
 def head_specs(head: HeadParams) -> dict[str, P]:
@@ -97,9 +121,16 @@ def head_specs(head: HeadParams) -> dict[str, P]:
 
 def local_view(head: HeadParams) -> HeadParams:
     """Inside shard_map the sharded leaves carry a leading stage dim of 1 —
-    drop it so the math below sees ``[Vs, H]`` / ``[H, Vs]``."""
+    drop it so the math below sees ``[Vs, H]`` / ``[H, Vs]``. QTensor leaves
+    drop it on q AND scale (plain ``v[0]`` would tuple-index the NamedTuple)."""
+
+    def drop(v):
+        if isinstance(v, QTensor):
+            return type(v)(q=v.q[0], scale=v.scale[0])
+        return v[0]
+
     return {
-        k: (v[0] if k in VOCAB_SHARDED else v) for k, v in head.items()
+        k: (drop(v) if k in VOCAB_SHARDED else v) for k, v in head.items()
     }
 
 
@@ -118,12 +149,13 @@ def sp_embed(
     positions: jnp.ndarray,  # [B, S] (gpt2 wpe; ignored for llama)
 ) -> jnp.ndarray:
     """Vocab-parallel embedding lookup → full [B, S, H] on every stage."""
-    table = head["embed"]  # [Vs, H]
-    Vs = table.shape[0]
+    table = head["embed"]  # [Vs, H] (raw or row-quantized)
+    Vs = base(table).shape[0]
     sidx = jax.lax.axis_index(PIPE_AXIS)
     local = ids - sidx * Vs
     ok = (local >= 0) & (local < Vs)
-    h = jnp.where(ok[..., None], table[jnp.clip(local, 0, Vs - 1)], 0)
+    rows = embed_rows(table, jnp.clip(local, 0, Vs - 1))
+    h = jnp.where(ok[..., None], rows, 0)
     h = jax.lax.psum(h, PIPE_AXIS)
     if cfg.model_type == "gpt2":
         # plain indexing clamps out-of-bounds (sentinel positions of padded
@@ -146,9 +178,9 @@ def _local_logits(
     else:
         x = rms_norm(h_last, head["final_norm"], cfg.rms_norm_eps)
     if "lm_head" in head:
-        logits = (x @ head["lm_head"]).astype(jnp.float32)  # [B, Vs]
+        logits = head_logits(x, head["lm_head"])  # [B, Vs]
     else:  # tied: contract against the local embedding slice
-        logits = jnp.einsum("bh,vh->bv", x, head["embed"]).astype(jnp.float32)
+        logits = tied_logits(x, head["embed"])
     Vs = logits.shape[-1]
     sidx = jax.lax.axis_index(PIPE_AXIS)
     lo = sidx * Vs

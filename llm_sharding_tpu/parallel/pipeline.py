@@ -50,6 +50,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models import gpt2, llama
 from ..models.cache import KVCache, POS_SENTINEL
 from ..models.config import ModelConfig
+from ..ops.quant import base
 from ..ops.sampling import is_stop as _is_stop
 from .head import (
     head_specs,
@@ -175,7 +176,7 @@ def ensure_sharded_head(cfg: ModelConfig, head_params, num_stages: int):
     or one already stacked by ``shard_head_host``. Hot paths (the engine)
     pre-shard once per placement; tests/dryruns may pass the full head."""
     if is_sharded_head(head_params):
-        got = head_params["embed"].shape[0]
+        got = base(head_params["embed"]).shape[0]
         if got != num_stages:
             # a head pre-stacked for S stages silently mis-slices vocab on a
             # mesh whose pipe size divides S — garbage tokens, no error

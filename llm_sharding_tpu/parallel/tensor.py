@@ -160,7 +160,7 @@ def shard_params_tp(cfg: ModelConfig, params: Any, mesh: Mesh) -> Any:
     """device_put params with megatron shardings; GSPMD does the rest
     (llama and gpt2 — no permutation needed here: jit keeps global
     semantics and XLA reshards the fused qkv split as required)."""
-    from ..ops.quant import is_quantized
+    from ..ops.quant import QTensor, is_quantized
 
     if cfg.model_type == "llama":
         specs = llama_tp_specs()
@@ -168,7 +168,9 @@ def shard_params_tp(cfg: ModelConfig, params: Any, mesh: Mesh) -> Any:
         specs = gpt2_tp_specs()
     else:
         raise NotImplementedError(f"TP specs: {cfg.model_type!r} unsupported")
-    if is_quantized(params["layers"]):
+    if is_quantized(params["layers"]) or any(
+        isinstance(v, QTensor) for k, v in params.items() if k != "layers"
+    ):
         raise NotImplementedError(
             "tensor parallelism over int8-quantized weights is not "
             "supported yet (QTensor leaves need per-component specs)"

@@ -467,12 +467,19 @@ def stage_memory_bytes(
     kv_capacity: int = 4096,
     param_dtype=jnp.bfloat16,
     cache_dtype=jnp.bfloat16,
+    head_dtype=None,
 ) -> list[int]:
     """Per-stage HBM accounting for a placement: padded layer params + KV
     cache rows + the vocab-SHARDED head slice (parallel/head.py — the head is
     no longer replicated per chip). Padded layers cost real memory — stages
     are padded to ``max_layers_per_stage`` (see placement.stack_stage_params),
-    which is what actually lands in each chip's HBM."""
+    which is what actually lands in each chip's HBM.
+
+    Quantized models: pass ``param_dtype=jnp.int8`` for int8/int4-resident
+    layer weights (scales are negligible), and ``head_dtype`` separately for
+    the vocab tables — the default ``quantize`` mode keeps them bf16 while
+    ``quantize_head`` makes them int8 too. ``head_dtype`` defaults to
+    ``param_dtype``."""
     from ..parallel.head import head_bytes_per_stage
 
     S = placement.num_stages
@@ -480,7 +487,7 @@ def stage_memory_bytes(
     per_layer = layer_param_bytes(cfg, param_dtype)
     kv = kv_cache_bytes_per_layer(cfg, batch_size, kv_capacity, cache_dtype)
     head = head_bytes_per_stage(
-        cfg, S, jnp.dtype(param_dtype).itemsize
+        cfg, S, jnp.dtype(head_dtype or param_dtype).itemsize
     )
     return [Lp * (per_layer + kv) + head for _ in range(S)]
 

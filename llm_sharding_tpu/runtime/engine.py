@@ -73,9 +73,10 @@ class PipelineEngine:
         # model bigger than one chip. np.asarray on bf16 jnp arrays is a
         # zero-copy-ish host pull via ml_dtypes.
         self._full_layers = jax.tree.map(np.asarray, params["layers"])
-        self._head_host = {
-            k: np.asarray(v) for k, v in params.items() if k != "layers"
-        }
+        # tree.map keeps QTensor leaves (int8 q + scale) as host QTensors
+        self._head_host = jax.tree.map(
+            np.asarray, {k: v for k, v in params.items() if k != "layers"}
+        )
         self.tokenizer = tokenizer
         self.cache_dtype = cache_dtype
         self._lock = threading.Lock()
@@ -160,8 +161,14 @@ class PipelineEngine:
         # embedding on user-facing nodes, lm_head on the last node,
         # node_worker.py:105-125, 155-164 — done as vocab parallelism).
         head_np = shard_head_host(self.cfg, self._head_host, spec.num_stages)
+        # tree.map so int8 QTensor tables (q + per-row scale, both stage-
+        # stacked on axis 0) take the pipe sharding leaf-by-leaf
         head_params = {
-            k: put_global(v, pipe_shard if k in VOCAB_SHARDED else repl)
+            k: jax.tree.map(
+                lambda a, s=(pipe_shard if k in VOCAB_SHARDED else repl):
+                    put_global(a, s),
+                v,
+            )
             for k, v in head_np.items()
         }
         # Swap everything atomically — a concurrent generate sees either the
@@ -328,10 +335,21 @@ class PipelineEngine:
         mechanism: raw text/ids never leave the accepting node,
         ``node_worker.py:215-223``). Computed from the host-resident full
         table — the device copies are vocab-sharded."""
+        from ..ops.quant import QTensor
+
         ids = np.asarray(prompt_ids, np.int32)
         if ids.ndim == 1:
             ids = ids[None]
-        h = np.asarray(self._head_host["embed"])[ids]
+        table = self._head_host["embed"]
+        if isinstance(table, QTensor):  # int8 row-quantized: dequant the rows
+            h = np.asarray(table.q)[ids].astype(np.float32)
+            h = h * np.asarray(table.scale, np.float32)[ids][..., None]
+            # back to the table's dtype so callers see the same embedding
+            # dtype whether or not the head is quantized (device embed_rows
+            # parity)
+            h = h.astype(np.asarray(table.scale).dtype)
+        else:
+            h = np.asarray(table)[ids]
         if self.cfg.model_type == "gpt2":
             pos = np.arange(ids.shape[1])
             h = h + np.asarray(self._head_host["pos_embed"])[pos][None]

@@ -59,11 +59,37 @@ _WEIGHT_SUFFIXES = (".bin", ".safetensors", ".pth", ".pt", ".gguf")
 # Int8-quantized weights (ops/quant.QTensor) are stored as a `<name>__q`
 # int8 array + `<name>__scale` pair and reassembled on load (≙ the
 # reference's load_in_8bit stores, ``model_sharder.py:28-45`` — quantized on
-# disk AND in device memory).
+# disk AND in device memory). Int4 weights (ops/quant.Int4QTensor, ≙
+# load_in_4bit) store TWO values per byte as `<name>__q4` (packed along the
+# last axis, odd sizes padded) + a `<name>__q4dim` last-axis size; they load
+# back as int8-resident Int4QTensors (see that class for why HBM residence
+# stays int8 on this stack).
 _DTYPE_TAG = "__dtype"
 _Q_SUFFIX = "__q"
+_Q4_SUFFIX = "__q4"
+_Q4_DIM_TAG = "__q4dim"
 _SCALE_SUFFIX = "__scale"
 _INT_VIEW = {1: np.uint8, 2: np.uint16, 4: np.uint32}
+
+
+def _pack_int4(a: np.ndarray) -> np.ndarray:
+    """int8 values in [-8, 7] → packed bytes, pairs along the last axis
+    (lo nibble = even index, hi nibble = odd index)."""
+    a = np.asarray(a, np.int8)
+    if a.shape[-1] % 2:
+        a = np.concatenate([a, np.zeros((*a.shape[:-1], 1), np.int8)], axis=-1)
+    lo = a[..., 0::2] & 0xF
+    hi = a[..., 1::2] & 0xF
+    return (lo | (hi << 4)).astype(np.int8)
+
+
+def _unpack_int4(p: np.ndarray, last_dim: int) -> np.ndarray:
+    """Packed bytes → int8 values (arithmetic shifts restore the sign)."""
+    p = np.asarray(p, np.int8)
+    lo = (p << 4) >> 4
+    hi = p >> 4
+    out = np.stack([lo, hi], axis=-1).reshape(*p.shape[:-1], -1)
+    return out[..., :last_dim]
 
 
 def _encode_array(out: dict, k: str, v) -> None:
@@ -76,11 +102,16 @@ def _encode_array(out: dict, k: str, v) -> None:
 
 
 def _save_npz(path: str, arrays: dict[str, Any]) -> None:
-    from ..ops.quant import QTensor
+    from ..ops.quant import Int4QTensor, QTensor
 
     out: dict[str, np.ndarray] = {}
     for k, v in arrays.items():
-        if isinstance(v, QTensor):
+        if isinstance(v, Int4QTensor):
+            q = np.asarray(v.q)
+            out[k + _Q4_SUFFIX] = _pack_int4(q)
+            out[k + _Q4_DIM_TAG] = np.asarray(q.shape[-1])
+            _encode_array(out, k + _SCALE_SUFFIX, v.scale)
+        elif isinstance(v, QTensor):
             _encode_array(out, k + _Q_SUFFIX, v.q)
             _encode_array(out, k + _SCALE_SUFFIX, v.scale)
         else:
@@ -91,7 +122,7 @@ def _save_npz(path: str, arrays: dict[str, Any]) -> None:
 def _load_npz(path: str, dtype) -> dict[str, Any]:
     import ml_dtypes
 
-    from ..ops.quant import QTensor
+    from ..ops.quant import Int4QTensor, QTensor
 
     def decode(z, k) -> np.ndarray:
         a = z[k]
@@ -103,9 +134,20 @@ def _load_npz(path: str, dtype) -> dict[str, Any]:
     with np.load(path) as z:
         res: dict[str, Any] = {}
         for k in z.files:
-            if k.endswith(_DTYPE_TAG) or k.endswith(_SCALE_SUFFIX):
+            if (
+                k.endswith(_DTYPE_TAG)
+                or k.endswith(_SCALE_SUFFIX)
+                or k.endswith(_Q4_DIM_TAG)
+            ):
                 continue
-            if k.endswith(_Q_SUFFIX):
+            if k.endswith(_Q4_SUFFIX):
+                base = k[: -len(_Q4_SUFFIX)]
+                q = _unpack_int4(z[k], int(z[base + _Q4_DIM_TAG]))
+                res[base] = Int4QTensor(
+                    q=jnp.asarray(q),  # int8-resident (see Int4QTensor)
+                    scale=jnp.asarray(decode(z, base + _SCALE_SUFFIX), dtype),
+                )
+            elif k.endswith(_Q_SUFFIX):
                 base = k[: -len(_Q_SUFFIX)]
                 res[base] = QTensor(
                     q=jnp.asarray(decode(z, k)),  # stays int8
@@ -157,13 +199,24 @@ def save_shards_streaming(
     dtype=jnp.bfloat16,
     tokenizer_dir: Optional[str] = None,
     quantize: bool = False,
+    quantize_head: bool = False,
+    quant_bits: int = 8,
 ) -> None:
     """Split directly from an HF name→tensor source, one unit at a time.
-    ``quantize`` stores layer matmul weights int8 (per-output-channel
-    scales in ``dtype``) — ≙ the reference's ``load_in_8bit`` conversion
-    mode (``model_sharder.py:28-45``); vocab tables and norms stay ``dtype``.
+    ``quantize`` stores layer matmul weights quantized (per-output-channel
+    scales in ``dtype``) — ≙ the reference's ``load_in_8bit``/``load_in_4bit``
+    conversion modes (``model_sharder.py:28-45``), with ``quant_bits``
+    selecting 8 (int8) or 4 (nibble-packed on disk); norms stay ``dtype``.
+    The vocab tables stay ``dtype`` too unless ``quantize_head`` (embed
+    per-ROW scales, untied lm_head per-column — see
+    ``ops/quant.quantize_params``).
     """
-    from ..ops.quant import quantize_layer_params
+    from ..ops.quant import quantize_layer_params, quantize_tensor
+
+    def maybe_q_embed(t):  # [V, H]: scale per vocab row
+        if not quantize_head:
+            return t
+        return quantize_tensor(t, contract_axis=-1, bits=quant_bits)
 
     get = _getter(src)
     os.makedirs(out_dir, exist_ok=True)
@@ -176,21 +229,24 @@ def save_shards_streaming(
     for i in range(cfg.num_hidden_layers):
         block = layer_fn(cfg, get, i, dtype)
         if quantize:
-            block = quantize_layer_params(block)
+            block = quantize_layer_params(block, bits=quant_bits)
         _save_npz(os.path.join(out_dir, f"block_{i}.npz"), block)
 
     if cfg.model_type == "llama":
         embed = jnp.asarray(get("model.embed_tokens.weight"), dtype)
-        _save_npz(os.path.join(out_dir, "embedding.npz"), {"embed": embed})
+        _save_npz(
+            os.path.join(out_dir, "embedding.npz"),
+            {"embed": maybe_q_embed(embed)},
+        )
         _save_npz(
             os.path.join(out_dir, "final_norm.npz"),
             {"final_norm": jnp.asarray(get("model.norm.weight"), dtype)},
         )
         if not cfg.tie_word_embeddings:
-            _save_npz(
-                os.path.join(out_dir, "lm_head.npz"),
-                {"lm_head": jnp.asarray(get("lm_head.weight").T, dtype)},
-            )
+            head = jnp.asarray(get("lm_head.weight").T, dtype)
+            if quantize_head:
+                head = quantize_tensor(head, contract_axis=-2, bits=quant_bits)
+            _save_npz(os.path.join(out_dir, "lm_head.npz"), {"lm_head": head})
     else:  # gpt2
         from .convert import _has
 
@@ -198,7 +254,10 @@ def save_shards_streaming(
         wte = jnp.asarray(get(pre + "wte.weight"), dtype)
         _save_npz(
             os.path.join(out_dir, "embedding.npz"),
-            {"embed": wte, "pos_embed": jnp.asarray(get(pre + "wpe.weight"), dtype)},
+            {
+                "embed": maybe_q_embed(wte),
+                "pos_embed": jnp.asarray(get(pre + "wpe.weight"), dtype),
+            },
         )
         _save_npz(
             os.path.join(out_dir, "final_norm.npz"),
@@ -300,7 +359,12 @@ def load_full(shards_dir: str, dtype=jnp.bfloat16) -> tuple[ModelConfig, dict]:
 
 
 def convert_hf_checkpoint(
-    model_dir: str, out_dir: str, dtype=jnp.bfloat16, quantize: bool = False
+    model_dir: str,
+    out_dir: str,
+    dtype=jnp.bfloat16,
+    quantize: bool = False,
+    quantize_head: bool = False,
+    quant_bits: int = 8,
 ) -> ModelConfig:
     """Offline conversion entry (≙ running ``ModelSharder`` as a script,
     ``/root/reference/utils/model_sharder.py:137-145``; ``quantize`` ≙ its
@@ -355,7 +419,8 @@ def convert_hf_checkpoint(
     try:
         save_shards_streaming(
             cfg, get, out_dir, dtype, tokenizer_dir=model_dir,
-            quantize=quantize,
+            quantize=quantize, quantize_head=quantize_head,
+            quant_bits=quant_bits,
         )
     finally:
         for h in handles:

@@ -123,3 +123,22 @@ def test_cold_start(tmp_path, params):
     assert rep.num_layers == CFG.num_hidden_layers
     assert len(rep.per_layer_s) == CFG.num_hidden_layers
     assert rep.total_s >= max(rep.per_layer_s)
+
+
+def test_stage_memory_quantized_head_accounting():
+    """HBM planning distinguishes int8-resident layers from the head's own
+    dtype: the default quantize mode (int8 layers, bf16 tables) must charge
+    2 bytes/element for the vocab shard, quantize_head models 1."""
+    from llm_sharding_tpu.parallel.head import head_bytes_per_stage
+    from llm_sharding_tpu.parallel.placement import PlacementSpec
+    from llm_sharding_tpu.profiler.profiler import stage_memory_bytes
+
+    spec = PlacementSpec.balanced(CFG.num_hidden_layers, 4)
+    all_int8 = stage_memory_bytes(CFG, spec, param_dtype=jnp.int8)
+    mixed = stage_memory_bytes(
+        CFG, spec, param_dtype=jnp.int8, head_dtype=jnp.bfloat16
+    )
+    want_delta = head_bytes_per_stage(CFG, 4, 2) - head_bytes_per_stage(
+        CFG, 4, 1
+    )
+    assert mixed[0] - all_int8[0] == want_delta > 0
