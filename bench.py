@@ -213,8 +213,10 @@ def bench_serve(on_tpu, cfg, params_np, jax, jnp):
     if on_tpu:
         # chunk_cycles=16: each step() ends in a host fetch, and on a
         # tunneled chip that sync is ~100 ms — coarser chunks amortize it
-        # (the serve numbers are otherwise tunnel-RTT noise, 60-85 tok/s)
-        batch_per_slot, capacity, chunk_cycles = 4, 512, 16
+        # (the serve numbers are otherwise tunnel-RTT noise, 60-85 tok/s).
+        # 8 rows (r3: was 4): decode is weight-read-bound, so rows amortize
+        # the 3.6 GB/step — the b8 monolith metric bounds what's reachable
+        batch_per_slot, capacity, chunk_cycles = 8, 512, 16
         prompt_len, max_new = 32, 256
     else:
         batch_per_slot, capacity, chunk_cycles = 2, 64, 2
@@ -244,7 +246,7 @@ def bench_serve(on_tpu, cfg, params_np, jax, jnp):
     srv = run(batch_per_slot, max_new)
     elapsed = time.perf_counter() - t0
     tok_s = srv.counters.tokens_generated / elapsed
-    emit(name, tok_s, "tokens/sec", tok_s / ANCHOR_TOK_S)
+    emit(name, tok_s, "tokens/sec", tok_s / ANCHOR_TOK_S, rows=batch_per_slot)
     del engine, srv
     gc.collect()
 

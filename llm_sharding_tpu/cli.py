@@ -113,11 +113,27 @@ def cmd_convert(args) -> int:
 def cmd_generate(args) -> int:
     eng = _engine(args)
     if args.stream:
-        for delta in eng.generate_text_stream(args.prompt, args.max_new):
+        # streaming goes through the shared continuous-batching server, whose
+        # top-k/top-p are server-level statics — per-request temperature/seed
+        # apply; non-default top-k/top-p need the non-streaming path
+        if args.top_k or args.top_p < 1.0:
+            raise SystemExit(
+                "--stream supports --temperature/--seed only (top-k/top-p "
+                "are server-level; drop --stream or the top-k/top-p flags)"
+            )
+        for delta in eng.generate_text_stream(
+            args.prompt, args.max_new,
+            temperature=args.temperature, seed=args.seed,
+        ):
             print(delta, end="", flush=True)
         print()
     else:
-        print(eng.generate_text(args.prompt, args.max_new))
+        print(
+            eng.generate_text(
+                args.prompt, args.max_new, temperature=args.temperature,
+                top_k=args.top_k, top_p=args.top_p, seed=args.seed,
+            )
+        )
     return 0
 
 
@@ -168,6 +184,8 @@ def _serve_control(eng, srv, line: str, args):
                 capacity=args.capacity,
                 batch_per_slot=args.batch_per_slot,
                 prefill_chunk=args.prefill_chunk,
+                top_k=args.top_k,
+                top_p=args.top_p,
             )
 
         try:
@@ -220,6 +238,8 @@ def cmd_serve(args) -> int:
         capacity=args.capacity,
         batch_per_slot=args.batch_per_slot,
         prefill_chunk=args.prefill_chunk,
+        top_k=args.top_k,
+        top_p=args.top_p,
     )
     print(
         f"serving {eng.cfg.model_type} over {eng.mesh.shape} "
@@ -236,7 +256,7 @@ def cmd_serve(args) -> int:
             srv = _serve_control(eng, srv, prompt, args)
             continue
         ids = np.asarray(tok(prompt)["input_ids"], np.int32)
-        req = srv.submit(ids, args.max_new)
+        req = srv.submit(ids, args.max_new, temperature=args.temperature)
         acc: list[int] = []
         prev = ""
         for t in srv.stream(req):
@@ -519,6 +539,10 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--ranges", help="ragged layer ranges, e.g. 0:6,6:7,7:32")
     g.add_argument("--dtype", default="bf16")
     g.add_argument("--stream", action="store_true")
+    g.add_argument("--temperature", type=float, default=0.0)
+    g.add_argument("--top-k", type=int, default=0, dest="top_k")
+    g.add_argument("--top-p", type=float, default=1.0, dest="top_p")
+    g.add_argument("--seed", type=int, default=0)
     g.set_defaults(fn=cmd_generate)
 
     s = sub.add_parser("serve", help="persistent stdin daemon (streaming)")
@@ -534,6 +558,9 @@ def build_parser() -> argparse.ArgumentParser:
         "streams keep producing during admission (power of two)",
     )
     s.add_argument("--dtype", default="bf16")
+    s.add_argument("--temperature", type=float, default=0.0)
+    s.add_argument("--top-k", type=int, default=0, dest="top_k")
+    s.add_argument("--top-p", type=float, default=1.0, dest="top_p")
     s.set_defaults(fn=cmd_serve)
 
     w = sub.add_parser(

@@ -21,7 +21,36 @@ def is_stop(cfg: ModelConfig, token: jnp.ndarray) -> jnp.ndarray:
     return jnp.any(token[:, None] == stops[None, :], axis=-1)
 
 
-def sample(logits: jnp.ndarray, key, temperature: float, top_k: int) -> jnp.ndarray:
+def validate_top_p(top_p) -> float:
+    """Range-check shared by every entry point (monolith, pipeline,
+    interleaved, server): outside (0, 1] the filter would silently mask the
+    whole vocabulary (≤ 0) or silently no-op (> 1)."""
+    top_p = float(top_p)
+    if not (0.0 < top_p <= 1.0):
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    return top_p
+
+
+def top_p_threshold(scaled: jnp.ndarray, top_p: float) -> jnp.ndarray:
+    """Nucleus threshold: ``[B, V]`` temperature-scaled (possibly already
+    top-k-masked) logits → ``[B, 1]`` smallest logit kept by top-p filtering
+    (HF semantics: the smallest set of highest-probability tokens whose
+    cumulative probability reaches ``top_p``; the most-likely token is always
+    kept). ``-inf`` columns (top-k mask, vocab padding in the sharded head)
+    carry zero probability and never affect the threshold, which is why the
+    sharded gather-then-threshold path is bitwise equal to the monolith's
+    (``parallel/head.sp_sample``)."""
+    desc = -jnp.sort(-scaled, axis=-1)  # descending
+    probs = jax.nn.softmax(desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p  # cumulative mass BEFORE each token
+    return jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True)
+
+
+def sample(
+    logits: jnp.ndarray, key, temperature: float, top_k: int,
+    top_p: float = 1.0,
+) -> jnp.ndarray:
     """logits: [B, V] → [B] int32. ``temperature <= 0`` means greedy.
 
     Implemented as explicit Gumbel-max (draw-identical to
@@ -31,12 +60,16 @@ def sample(logits: jnp.ndarray, key, temperature: float, top_k: int) -> jnp.ndar
     and slices its vocab columns — see ``parallel/head.sp_sample``. Sampling
     every path through one definition is the r2 weak-#8 fix (the reference is
     greedy-only, ``/root/reference/utils/node_worker.py:262-265``; sampling is
-    additive capability and must at least agree with itself)."""
+    additive capability and must at least agree with itself). Filters compose
+    HF-style: top-k first, then top-p over what survives."""
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = (logits / temperature).astype(jnp.float32)
     if top_k > 0:
         kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
         scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    if top_p < 1.0:
+        thresh = top_p_threshold(scaled, top_p)
+        scaled = jnp.where(scaled < thresh, -jnp.inf, scaled)
     g = jax.random.gumbel(key, scaled.shape, jnp.float32)
     return jnp.argmax(scaled + g, axis=-1).astype(jnp.int32)

@@ -235,6 +235,7 @@ def context_generate(
     capacity=None,
     temperature: float = 0.0,
     top_k: int = 0,
+    top_p: float = 1.0,
     seed: int = 0,
     cache_dtype=jnp.bfloat16,
 ):
@@ -249,7 +250,7 @@ def context_generate(
     return decode_from_cache(
         cfg, params, token_ids, logits, cache, max_new_tokens,
         prompt_len=prompt_len, capacity=capacity, temperature=temperature,
-        top_k=top_k, seed=seed,
+        top_k=top_k, top_p=top_p, seed=seed,
     )
 
 

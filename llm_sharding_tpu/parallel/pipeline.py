@@ -51,7 +51,7 @@ from ..models import gpt2, llama
 from ..models.cache import KVCache, POS_SENTINEL
 from ..models.config import ModelConfig
 from ..ops.quant import base
-from ..ops.sampling import is_stop as _is_stop
+from ..ops.sampling import is_stop as _is_stop, validate_top_p
 from .head import (
     head_specs,
     is_sharded_head,
@@ -197,7 +197,7 @@ class PipelineResult(NamedTuple):
     jax.jit,
     static_argnames=(
         "cfg", "mesh", "num_stages", "max_new_tokens", "capacity",
-        "cache_dtype", "temperature", "top_k",
+        "cache_dtype", "temperature", "top_k", "top_p",
     ),
 )
 def _pipeline_generate_jit(
@@ -215,6 +215,7 @@ def _pipeline_generate_jit(
     cache_dtype,
     temperature: float,
     top_k: int,
+    top_p: float,
 ):
     from .mesh import DATA_AXIS
 
@@ -279,7 +280,7 @@ def _pipeline_generate_jit(
         h_last = psum_from(h_last, 0)
         key, sub = jax.random.split(key)
         tok = sp_sample(
-            cfg, hd, h_last, sub, temperature, top_k, num_stages
+            cfg, hd, h_last, sub, temperature, top_k, num_stages, top_p
         )  # [B], replicated
 
         out = jnp.zeros((Bl, total), jnp.int32)
@@ -306,7 +307,9 @@ def _pipeline_generate_jit(
             h, cache = chain(h, s["cache"], tok_pos)
             h_last = psum_from(h[:, 0], 0)
             key, sub = jax.random.split(s["key"])
-            nxt = sp_sample(cfg, hd, h_last, sub, temperature, top_k, num_stages)
+            nxt = sp_sample(
+                cfg, hd, h_last, sub, temperature, top_k, num_stages, top_p
+            )
             nxt = jnp.where(s["done"], 0, nxt)
             new_pos = s["pos"] + 1
             out = s["out"].at[jnp.arange(Bl), new_pos].set(nxt)
@@ -358,12 +361,13 @@ def pipeline_generate(
     cache_dtype=jnp.bfloat16,
     temperature: float = 0.0,
     top_k: int = 0,
+    top_p: float = 1.0,
     seed: int = 0,
 ) -> PipelineResult:
     """Pipelined generation across the mesh (host-facing entry). Greedy by
-    default; ``temperature``/``top_k``/``seed`` sample token-exactly vs the
-    monolithic ``runtime.generate`` (r2 weak #8 — one sampling surface for
-    every path)."""
+    default; ``temperature``/``top_k``/``top_p``/``seed`` sample token-exactly
+    vs the monolithic ``runtime.generate`` (r2 weak #8 — one sampling surface
+    for every path)."""
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
     if prompt_ids.ndim == 1:
         prompt_ids = prompt_ids[None]
@@ -429,6 +433,7 @@ def pipeline_generate(
         cache_dtype,
         float(temperature),
         int(top_k),
+        validate_top_p(top_p),
     )
     if jax.process_count() > 1 and dp > 1:
         # dp-sharded outputs span non-addressable devices; assemble the

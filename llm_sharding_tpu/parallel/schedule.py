@@ -46,7 +46,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.cache import KVCache, POS_SENTINEL
 from ..models.config import ModelConfig
-from ..ops.sampling import is_stop as _is_stop
+from ..ops.sampling import is_stop as _is_stop, validate_top_p
 from .head import (
     head_specs, key_chain_split, local_view, psum_from, seed_chain_init,
     sp_embed, sp_next_token, sp_sample_rows,
@@ -70,7 +70,7 @@ class InterleavedResult(NamedTuple):
     jax.jit,
     static_argnames=(
         "cfg", "mesh", "num_stages", "max_new_tokens", "capacity",
-        "cache_dtype", "top_k", "sampling",
+        "cache_dtype", "top_k", "top_p", "sampling",
     ),
 )
 def _interleaved_jit(
@@ -89,6 +89,7 @@ def _interleaved_jit(
     capacity: int,
     cache_dtype,
     top_k: int,
+    top_p: float,
     sampling: bool,
 ):
     fns = model_fns(cfg)
@@ -132,7 +133,7 @@ def _interleaved_jit(
             # sample) — the SAME shared helpers as the serve path
             row_keys, subs = seed_chain_init(seeds)  # [M, 2] each
             tok0 = sp_sample_rows(
-                cfg, hd, h_last, subs, temperature, top_k, num_stages
+                cfg, hd, h_last, subs, temperature, top_k, num_stages, top_p
             )
         else:
             row_keys = jnp.zeros((M, 2), jnp.uint32)
@@ -239,7 +240,7 @@ def _interleaved_jit(
                 new_keys, subs = key_chain_split(rng_rows)
                 temp_rows = jax.lax.dynamic_slice_in_dim(temperature, rowd, Bs)
                 nxt = sp_sample_rows(
-                    cfg, hd, h_done, subs, temp_rows, top_k, num_stages
+                    cfg, hd, h_done, subs, temp_rows, top_k, num_stages, top_p
                 )
             else:
                 nxt = sp_next_token(cfg, hd, h_done)  # [Bs], replicated
@@ -325,6 +326,7 @@ def interleaved_generate(
     cache_dtype=jnp.bfloat16,
     temperature=0.0,  # scalar or per-request [R]; <= 0 → greedy
     top_k: int = 0,
+    top_p: float = 1.0,
     seeds=None,  # per-request sampling seeds [R] (default zeros)
 ) -> InterleavedResult:
     """Generate for up to ``num_stages * batch_per_slot`` requests
@@ -389,6 +391,7 @@ def interleaved_generate(
         capacity,
         cache_dtype,
         int(top_k),
+        validate_top_p(top_p),
         sampling,
     )
     return InterleavedResult(np.asarray(out)[:R], np.asarray(lengths)[:R])

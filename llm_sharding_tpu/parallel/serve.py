@@ -156,7 +156,7 @@ def make_state(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "mesh", "num_stages", "cache_dtype", "top_k"),
+    static_argnames=("cfg", "mesh", "num_stages", "cache_dtype", "top_k", "top_p"),
 )
 def serve_admit(
     cfg: ModelConfig,
@@ -175,6 +175,7 @@ def serve_admit(
     num_stages: int,
     cache_dtype,
     top_k: int = 0,
+    top_p: float = 1.0,
 ):
     """Prefill ``slot`` with up to Bs new requests while the rest of the
     pipeline state is parked. Returns the updated state."""
@@ -221,7 +222,7 @@ def serve_admit(
         # B=1 tokens exactly (r2 weak #8).
         row_keys, subs = seed_chain_init(seeds)  # [Bs, 2] each
         tok0 = sp_sample_rows(
-            cfg, hd, h_last, subs, temperature, top_k, num_stages
+            cfg, hd, h_last, subs, temperature, top_k, num_stages, top_p
         )  # [Bs] replicated
         tok0 = jnp.where(row_valid, tok0, 0)
 
@@ -496,7 +497,7 @@ def serve_admit_finish(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "cfg", "mesh", "num_stages", "n_micro", "top_k", "sampling",
+        "cfg", "mesh", "num_stages", "n_micro", "top_k", "top_p", "sampling",
     ),
 )
 def serve_chunk(
@@ -509,6 +510,7 @@ def serve_chunk(
     num_stages: int,
     n_micro: int,
     top_k: int = 0,
+    top_p: float = 1.0,
     sampling: bool = False,
 ):
     """Run ``n_micro`` interleaved microsteps on the live state.
@@ -605,7 +607,7 @@ def serve_chunk(
                 new_keys, subs = key_chain_split(rng_rows)
                 temp_rows = jax.lax.dynamic_slice_in_dim(s.temp, rowd, Bs)
                 nxt = sp_sample_rows(
-                    cfg, hd, h_done, subs, temp_rows, top_k, num_stages
+                    cfg, hd, h_done, subs, temp_rows, top_k, num_stages, top_p
                 )
             else:
                 nxt = sp_next_token(cfg, hd, h_done)

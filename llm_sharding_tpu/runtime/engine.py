@@ -197,6 +197,7 @@ class PipelineEngine:
         capacity: Optional[int] = None,
         temperature: float = 0.0,
         top_k: int = 0,
+        top_p: float = 1.0,
         seed: int = 0,
     ) -> PipelineResult:
         with self._lock:
@@ -215,6 +216,7 @@ class PipelineEngine:
             cache_dtype=self.cache_dtype,
             temperature=temperature,
             top_k=top_k,
+            top_p=top_p,
             seed=seed,
         )
 
@@ -227,6 +229,7 @@ class PipelineEngine:
         capacity: Optional[int] = None,
         temperature=0.0,
         top_k: int = 0,
+        top_p: float = 1.0,
         seeds=None,
     ):
         """Serve up to ``num_stages`` requests concurrently with the
@@ -250,13 +253,26 @@ class PipelineEngine:
             cache_dtype=self.cache_dtype,
             temperature=temperature,
             top_k=top_k,
+            top_p=top_p,
             seeds=seeds,
         )
 
-    def generate_text(self, prompt: str, max_new_tokens: int = 128) -> str:
+    def generate_text(
+        self,
+        prompt: str,
+        max_new_tokens: int = 128,
+        *,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: int = 0,
+    ) -> str:
         tok = self._require_tokenizer()
         ids = np.asarray(tok(prompt)["input_ids"], np.int32)[None]
-        res = self.generate_ids(ids, max_new_tokens)
+        res = self.generate_ids(
+            ids, max_new_tokens, temperature=temperature, top_k=top_k,
+            top_p=top_p, seed=seed,
+        )
         out_ids = res.tokens[0, ids.shape[1] : int(res.lengths[0])]
         return tok.decode(out_ids, skip_special_tokens=True)
 
@@ -267,6 +283,7 @@ class PipelineEngine:
         batch_per_slot: int = 1,
         chunk_cycles: int = 1,
         top_k: int = 0,
+        top_p: float = 1.0,
         prefill_chunk: Optional[int] = None,
     ):
         """Build a continuous-batching server over this engine's sharded
@@ -280,6 +297,7 @@ class PipelineEngine:
             batch_per_slot=batch_per_slot,
             chunk_cycles=chunk_cycles,
             top_k=top_k,
+            top_p=top_p,
             prefill_chunk=prefill_chunk,
         )
 
@@ -307,7 +325,12 @@ class PipelineEngine:
         return srv
 
     def generate_text_stream(
-        self, prompt: str, max_new_tokens: int = 128
+        self,
+        prompt: str,
+        max_new_tokens: int = 128,
+        *,
+        temperature: float = 0.0,
+        seed: int = 0,
     ) -> Iterator[str]:
         """Streaming text deltas (≙ node_worker.py:286-298), served from the
         SHARDED pipeline: tokens surface one ring cycle at a time via the
@@ -317,7 +340,7 @@ class PipelineEngine:
         tok = self._require_tokenizer()
         ids = np.asarray(tok(prompt)["input_ids"], np.int32)
         srv = self._shared_server(ids.shape[0], max_new_tokens)
-        req = srv.submit(ids, max_new_tokens)
+        req = srv.submit(ids, max_new_tokens, temperature=temperature, seed=seed)
         prev = ""
         acc: list[int] = []
         for t in srv.stream(req):

@@ -111,6 +111,7 @@ class PipelineServer:
         batch_per_slot: int = 1,
         chunk_cycles: int = 1,
         top_k: int = 0,
+        top_p: float = 1.0,
         prefill_chunk: Optional[int] = None,
     ):
         self.engine = engine
@@ -120,13 +121,17 @@ class PipelineServer:
         self.batch_per_slot = batch_per_slot
         self.capacity = capacity
         self.chunk_cycles = chunk_cycles
-        # top-k is server-level (a static program parameter — per-request
-        # values would recompile serve_chunk); temperature/seed are per-request.
+        # top-k/top-p are server-level (static program parameters — per-
+        # request values would recompile serve_chunk); temperature/seed are
+        # per-request.
         # The decode program compiles greedy-only until the first sampled
         # request arrives (the sampler costs ~20% steady-state throughput;
         # top_k alone cannot change an argmax), then sticks with the
         # sampling variant.
+        from ..ops.sampling import validate_top_p
+
         self.top_k = top_k
+        self.top_p = validate_top_p(top_p)
         self._sampling = False
         # chunked admission (r2 weak #4): prompts longer than this are
         # prefilled in bounded chunks with decode cycles interleaved, so a
@@ -220,6 +225,7 @@ class PipelineServer:
                 self.num_stages,
                 self.num_stages * self.chunk_cycles,
                 self.top_k,
+                self.top_p,
                 self._sampling,
             )
             self.counters.chunks += 1
@@ -332,6 +338,7 @@ class PipelineServer:
                     self.num_stages,
                     self.engine.cache_dtype,
                     self.top_k,
+                    self.top_p,
                 )
             self.counters.admissions += 1
             admitted = True
@@ -390,6 +397,7 @@ class PipelineServer:
                     self.num_stages,
                     self.num_stages,  # one ring cycle between chunks
                     self.top_k,
+                    self.top_p,
                     self._sampling,
                 )
                 self.counters.chunks += 1

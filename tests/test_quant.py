@@ -305,6 +305,21 @@ def test_quantized_head_pipeline_and_serve_token_exact(qh_setup):
     ]
 
 
+def test_quantized_head_sampling_parity(qh_setup):
+    """Seeded temperature/top-k sampling over the vocab-sharded int8 head
+    draws the monolith's tokens exactly (the fp32 logits + sliced-noise
+    contract of parallel/head.sp_sample holds for quantized tables)."""
+    qh = qh_setup
+    eng = PipelineEngine(CFG, qh, num_stages=4, cache_dtype=jnp.float32)
+    prompt = np.array([[5, 9, 2, 14]], np.int32)
+    a = generate(
+        CFG, qh, prompt, 8, temperature=0.8, top_k=5, seed=3,
+        cache_dtype=jnp.float32,
+    )
+    b = eng.generate_ids(prompt, 8, temperature=0.8, top_k=5, seed=3)
+    np.testing.assert_array_equal(a.tokens, b.tokens)
+
+
 def test_quantized_head_store_round_trip(qh_setup, tmp_path):
     from llm_sharding_tpu.utils import shard_store
 

@@ -87,6 +87,78 @@ def test_sample_respects_top_k():
         assert bool(jnp.all(picked >= top[:, 0]))
 
 
+def test_sample_respects_top_p():
+    """Draws never leave the nucleus: the cumulative probability of the
+    tokens ranked above the drawn one must be < top_p (HF semantics: the
+    smallest prefix reaching top_p is kept, best token always included)."""
+    from llm_sharding_tpu.ops.sampling import sample
+
+    logits = jax.random.normal(jax.random.key(1), (4, 64)) * 3.0
+    top_p, temp = 0.6, 1.1
+    scaled = np.asarray(logits, np.float64) / temp
+    probs = np.exp(scaled - scaled.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    order = np.argsort(-probs, axis=-1)
+    sorted_p = np.take_along_axis(probs, order, axis=-1)
+    cum_before = np.cumsum(sorted_p, axis=-1) - sorted_p
+    kept_count = (cum_before < top_p).sum(-1)
+    for seed in range(8):
+        tok = np.asarray(sample(logits, jax.random.key(seed), temp, 0, top_p))
+        for b in range(4):
+            rank = int(np.where(order[b] == tok[b])[0][0])
+            assert rank < kept_count[b], (
+                f"draw outside nucleus: rank {rank} >= kept {kept_count[b]}"
+            )
+
+
+@pytest.mark.parametrize("temperature,top_k,top_p,seed", [
+    (0.8, 0, 0.7, 0), (1.0, 17, 0.9, 3), (0.6, 0, 0.5, 9),
+])
+def test_pipeline_top_p_matches_monolith(
+    engine, params, temperature, top_k, top_p, seed
+):
+    """Nucleus sampling through the vocab-sharded head (gathered-threshold
+    path, padded vocab shards) == the monolith, token-exact."""
+    prompt = np.array([[5, 9, 2, 14], [7, 3, 1, 8]], dtype=np.int32)
+    mono = MonolithicEngine(CFG, params, cache_dtype=jnp.float32)
+    a = mono.generate_ids(
+        prompt, 12, temperature=temperature, top_k=top_k, top_p=top_p,
+        seed=seed,
+    )
+    b = engine.generate_ids(
+        prompt, 12, temperature=temperature, top_k=top_k, top_p=top_p,
+        seed=seed,
+    )
+    np.testing.assert_array_equal(a.tokens, b.tokens)
+
+
+def test_serve_top_p_matches_monolith(engine, params):
+    """Server-level top-p (like top-k, a static program parameter): sampled
+    rows draw the monolith's nucleus-filtered tokens, greedy rows stay
+    greedy."""
+    srv = engine.serve(capacity=64, batch_per_slot=1, top_p=0.8)
+    pa = np.array([5, 9, 2, 14], np.int32)
+    pb = np.array([7, 3, 1], np.int32)
+    specs = [(pa, 0.9, 21, 0.8), (pb, 0.7, 4, 0.8), (pa, 0.0, 0, 1.0)]
+    reqs = [srv.submit(p, 10, temperature=t, seed=s) for p, t, s, _ in specs]
+    srv.run_until_idle()
+    for req, (p, t, s, tp) in zip(reqs, specs):
+        m = generate(
+            CFG, params, p[None], 10, temperature=t, top_p=tp, seed=s,
+            cache_dtype=jnp.float32,
+        )
+        want = [int(x) for x in m.tokens[0][len(p): int(m.lengths[0])]]
+        assert req.tokens == want
+
+
+def test_top_p_validation():
+    with pytest.raises(ValueError, match="top_p"):
+        generate(
+            CFG, llama.init_params(CFG, jax.random.key(0), jnp.float32),
+            np.array([[1, 2]], np.int32), 2, temperature=0.5, top_p=0.0,
+        )
+
+
 def test_interleaved_sample_matches_monolith(engine, params):
     """The interleaved throughput scheduler samples per-row: request r with
     temperature>0 and seed s draws the monolith's B=1 ``generate(...,
