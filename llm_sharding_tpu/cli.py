@@ -116,7 +116,9 @@ def cmd_generate(args) -> int:
         # streaming goes through the shared continuous-batching server, whose
         # top-k/top-p are server-level statics — per-request temperature/seed
         # apply; non-default top-k/top-p need the non-streaming path
-        if args.top_k or args.top_p < 1.0:
+        # (`!= 1.0`, not `< 1.0`: an out-of-range value like 1.5 must be
+        # rejected here too, not silently stream unfiltered)
+        if args.top_k or args.top_p != 1.0:
             raise SystemExit(
                 "--stream supports --temperature/--seed only (top-k/top-p "
                 "are server-level; drop --stream or the top-k/top-p flags)"

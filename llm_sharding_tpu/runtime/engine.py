@@ -66,7 +66,15 @@ class PipelineEngine:
         devices: Optional[list] = None,
         tokenizer: Any = None,
         cache_dtype=jnp.bfloat16,
+        data_parallel: int = 1,
+        tensor_parallel: int = 1,
     ):
+        """``data_parallel``/``tensor_parallel`` compose with the pipeline:
+        the engine builds a (data, pipe, tensor) mesh and the SAME shard_map
+        program runs dp×pp / pp×tp hybrids (tests/test_hybrid.py wired these
+        at the ``pipeline_generate`` level; here they are user-reachable).
+        Stage count defaults to ``devices / (dp·tp)``. The continuous-
+        batching server and the interleaved scheduler remain pipe-only."""
         self.cfg = cfg
         # The repartition source stays on HOST (numpy): only each device's
         # stage slice ever lands in HBM — the whole point of pipelining a
@@ -80,13 +88,47 @@ class PipelineEngine:
         self.tokenizer = tokenizer
         self.cache_dtype = cache_dtype
         self._lock = threading.Lock()
+        self.data_parallel = int(data_parallel)
+        self.tensor_parallel = int(tensor_parallel)
+        if self.data_parallel < 1 or self.tensor_parallel < 1:
+            raise ValueError("data_parallel/tensor_parallel must be >= 1")
+        if self.tensor_parallel > 1:
+            from ..ops.quant import is_quantized
+            from ..parallel.tensor import validate_tp
+
+            validate_tp(cfg, self.tensor_parallel)
+            if is_quantized(self._full_layers):
+                raise NotImplementedError(
+                    "tensor parallelism over int8-quantized weights is not "
+                    "supported yet (QTensor leaves need per-component specs)"
+                )
 
         if placement is None:
-            n = num_stages or len(devices or jax.devices())
+            n = num_stages
+            if n is None:
+                n_dev = len(devices or jax.devices())
+                rep = self.data_parallel * self.tensor_parallel
+                if n_dev % rep:
+                    raise ValueError(
+                        f"{n_dev} devices not divisible by dp×tp = {rep}"
+                    )
+                n = n_dev // rep
             placement = PlacementSpec.balanced(cfg.num_hidden_layers, n)
-        self.mesh = pipeline_mesh(placement.num_stages, devices)
+        self.mesh = self._build_mesh(placement.num_stages, devices)
         self._devices = devices
         self.apply_placement(placement)
+
+    def _build_mesh(self, num_stages: int, devices):
+        if self.data_parallel == 1 and self.tensor_parallel == 1:
+            return pipeline_mesh(num_stages, devices)
+        from ..parallel.distributed import hybrid_mesh
+
+        return hybrid_mesh(
+            data=self.data_parallel,
+            pipe=num_stages,
+            tensor=self.tensor_parallel,
+            devices=devices,
+        )
 
     # -- construction helpers ------------------------------------------------
 
@@ -100,6 +142,8 @@ class PipelineEngine:
         devices: Optional[list] = None,
         dtype=jnp.bfloat16,
         cache_dtype=jnp.bfloat16,
+        data_parallel: int = 1,
+        tensor_parallel: int = 1,
     ) -> "PipelineEngine":
         """Load from a shard store (≙ NodeController startup: receive config
         → load_shards, ``node_worker.py:403-421``)."""
@@ -120,6 +164,8 @@ class PipelineEngine:
             devices=devices,
             tokenizer=tokenizer,
             cache_dtype=cache_dtype,
+            data_parallel=data_parallel,
+            tensor_parallel=tensor_parallel,
         )
 
     # -- control plane (≙ ConfigSender.send_config / check_new_config) ------
@@ -135,8 +181,8 @@ class PipelineEngine:
             )
         if spec.num_stages != self.mesh.shape[PIPE_AXIS]:
             # stage-count change needs a new mesh (≙ worker recreation when
-            # the role bit flips, node_worker.py:455-466)
-            mesh = pipeline_mesh(spec.num_stages, self._devices)
+            # the role bit flips, node_worker.py:455-466); dp/tp carry over
+            mesh = self._build_mesh(spec.num_stages, self._devices)
         else:
             mesh = self.mesh
 
@@ -152,9 +198,22 @@ class PipelineEngine:
         # addressable shards, so the same code path serves single-controller
         # and multi-controller runs (r2 missing #1 — the host-numpy
         # device_put broke under multi-host SPMD).
-        stage_layers = jax.tree.map(
-            lambda a: put_global(a, pipe_shard), stage_np
-        )
+        # With tensor parallelism, llama weights land pre-split with the
+        # megatron specs the pipeline program uses (no tensor-axis replica in
+        # HBM); gpt2 stays pipe-sharded — pipeline_generate column-permutes
+        # its fused qkv device-side before the tensor split applies.
+        if self.tensor_parallel > 1 and self.cfg.model_type == "llama":
+            from ..parallel.pipeline import stage_layer_specs
+
+            leaf_specs = stage_layer_specs(self.cfg, self.tensor_parallel)
+            stage_layers = {
+                k: put_global(a, NamedSharding(mesh, leaf_specs[k]))
+                for k, a in stage_np.items()
+            }
+        else:
+            stage_layers = jax.tree.map(
+                lambda a: put_global(a, pipe_shard), stage_np
+            )
         masks = put_global(masks_np, pipe_shard)
         # Vocab-shard the embedding/lm_head over the pipe axis: each chip
         # holds only its V/num_stages slice (≙ the reference's role split —
@@ -235,6 +294,7 @@ class PipelineEngine:
         """Serve up to ``num_stages`` requests concurrently with the
         interleaved schedule — all stages busy every microstep (the
         throughput mode; see parallel/schedule.py)."""
+        self._require_pipe_only("generate_many")
         from ..parallel.schedule import interleaved_generate
 
         with self._lock:
@@ -289,6 +349,7 @@ class PipelineEngine:
         """Build a continuous-batching server over this engine's sharded
         arrays (≙ the reference's persistent ``run_worker_loop`` daemon,
         ``node_worker.py:493-559``). See ``runtime/server.py``."""
+        self._require_pipe_only("serve")
         from .server import PipelineServer
 
         return PipelineServer(
@@ -377,6 +438,13 @@ class PipelineEngine:
             pos = np.arange(ids.shape[1])
             h = h + np.asarray(self._head_host["pos_embed"])[pos][None]
         return jnp.asarray(h)
+
+    def _require_pipe_only(self, what: str) -> None:
+        if self.data_parallel > 1 or self.tensor_parallel > 1:
+            raise NotImplementedError(
+                f"{what} runs on the pipe-only engine; hybrid dp/tp engines "
+                "support generate_ids (the shard_map pipeline program)"
+            )
 
     def _require_tokenizer(self):
         if self.tokenizer is None:
