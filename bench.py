@@ -328,6 +328,10 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    from llm_sharding_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()  # repeat bench runs skip the ~20-40s compiles
+
     on_tpu = jax.devices()[0].platform != "cpu"
     # error lines must carry the same platform-qualified names the sections
     # emit — a CPU smoke failure must never register under a chip metric

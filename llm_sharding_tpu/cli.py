@@ -647,4 +647,9 @@ def main(argv=None) -> int:
         level=logging.INFO if args.verbose else logging.WARNING,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    # persist compiled executables across daemon restarts/repeat runs
+    # (LLM_SHARDING_TPU_CACHE=off to disable; utils/compile_cache.py)
+    from .utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     return args.fn(args)

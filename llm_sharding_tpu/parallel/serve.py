@@ -154,6 +154,16 @@ def make_state(
     return state
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def serve_cancel_rows(state: ServeState, rows_mask: jnp.ndarray) -> ServeState:
+    """Mark rows done from the host between chunks (request cancellation and
+    host-side stop sequences). Safe by the same mechanism EOS uses: a row
+    whose ``done`` flips at a chunk boundary stops committing tokens, its
+    in-flight block is dropped by the post-update validity gating in
+    ``serve_chunk``, and the slot frees once all its rows are done."""
+    return state._replace(done=state.done | rows_mask)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "mesh", "num_stages", "cache_dtype", "top_k", "top_p"),

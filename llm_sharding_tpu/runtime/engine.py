@@ -392,6 +392,7 @@ class PipelineEngine:
         *,
         temperature: float = 0.0,
         seed: int = 0,
+        stop=None,
     ) -> Iterator[str]:
         """Streaming text deltas (≙ node_worker.py:286-298), served from the
         SHARDED pipeline: tokens surface one ring cycle at a time via the
@@ -401,7 +402,9 @@ class PipelineEngine:
         tok = self._require_tokenizer()
         ids = np.asarray(tok(prompt)["input_ids"], np.int32)
         srv = self._shared_server(ids.shape[0], max_new_tokens)
-        req = srv.submit(ids, max_new_tokens, temperature=temperature, seed=seed)
+        req = srv.submit(
+            ids, max_new_tokens, temperature=temperature, seed=seed, stop=stop
+        )
         prev = ""
         acc: list[int] = []
         for t in srv.stream(req):

@@ -56,6 +56,7 @@ class Counters:
 
     requests_submitted: int = 0
     requests_completed: int = 0
+    requests_cancelled: int = 0
     tokens_generated: int = 0
     admissions: int = 0
     chunks: int = 0
@@ -69,7 +70,7 @@ class Request:
 
     __slots__ = (
         "id", "prompt", "prompt_len", "max_new", "tokens", "done", "row",
-        "temperature", "seed",
+        "temperature", "seed", "stop", "stop_checked",
         "submitted_at", "started_at", "finished_at",
     )
 
@@ -80,6 +81,7 @@ class Request:
         max_new: int,
         temperature: float = 0.0,
         seed: int = 0,
+        stop: tuple = (),
     ):
         self.id = rid
         self.prompt = prompt
@@ -87,6 +89,8 @@ class Request:
         self.max_new = max_new
         self.temperature = temperature  # <= 0 → greedy
         self.seed = seed
+        self.stop = stop  # stop strings (host-side detok check)
+        self.stop_checked = 0  # tokens already scanned for stop strings
         self.tokens: list[int] = []  # generated ids (incl. EOS if produced)
         self.done = False
         self.row: Optional[int] = None
@@ -163,6 +167,11 @@ class PipelineServer:
         # previous occupant's values until serve_admit_finish arms the slot,
         # so interleaved fetches must skip them
         self._admitting_rows: set[int] = set()
+        # rows cancelled while their slot was mid-chunked-admission: the
+        # device-side done flag cannot be set yet (serve_admit_finish would
+        # overwrite it when it arms the slot), so the cancel is applied right
+        # after the finish program runs
+        self._pending_cancels: set[int] = set()
         self._ids = itertools.count()
 
     # ------------------------------------------------------------------ API
@@ -174,6 +183,7 @@ class PipelineServer:
         *,
         temperature: float = 0.0,
         seed: int = 0,
+        stop=None,  # iterable of stop STRINGS (host-side, needs a tokenizer)
     ) -> Request:
         """Enqueue a request (≙ ``receive_user_request``, admission happens
         on the next ``step``). ``temperature > 0`` samples with this
@@ -197,9 +207,19 @@ class PipelineServer:
                 f"requested {total} positions > max_position_embeddings "
                 f"({self.cfg.max_position_embeddings})"
             )
+        stop = tuple(stop or ())
+        if stop:
+            if any(not isinstance(x, str) or not x for x in stop):
+                raise ValueError("stop must be non-empty strings")
+            if self.engine.tokenizer is None:
+                raise ValueError(
+                    "stop sequences need a tokenizer (engine.tokenizer is "
+                    "None — construct via from_shards on a store with "
+                    "tokenizer files, or pass tokenizer=)"
+                )
         req = Request(
             next(self._ids), prompt, max_new_tokens,
-            temperature=temperature, seed=seed,
+            temperature=temperature, seed=seed, stop=stop,
         )
         if temperature > 0:
             self._sampling = True
@@ -239,6 +259,43 @@ class PipelineServer:
         while self._queue or self._any_active():
             self.step()
 
+    def cancel(self, req: Request) -> bool:
+        """Cancel a queued or in-flight request (a capability the reference
+        lacks entirely — its chain runs every request to EOS/max,
+        ``node_worker.py:290-292``). Returns True if the request was live.
+        In-flight rows are marked done on device between chunks
+        (``serve_cancel_rows``) and the slot row frees for re-admission."""
+        if req.done:
+            return False
+        if req.row is None:  # still queued
+            try:
+                self._queue.remove(req)
+            except ValueError:
+                return False
+            req.done = True
+            req.finished_at = time.perf_counter()
+            self.counters.requests_cancelled += 1
+            return True
+        if req.row in self._admitting_rows:
+            # mid-chunked-admission: serve_admit_finish rewrites the slot's
+            # done flags when it arms it, which would resurrect a flag set
+            # now — defer the device-side cancel until the finish runs
+            self._pending_cancels.add(req.row)
+        else:
+            self._cancel_rows([req.row])
+        req.done = True
+        req.finished_at = time.perf_counter()
+        self._rows[req.row] = None
+        self.counters.requests_cancelled += 1
+        logger.info("cancel id=%d row=%d tokens=%d", req.id, req.row,
+                    len(req.tokens))
+        return True
+
+    def _cancel_rows(self, rows: list) -> None:
+        mask = np.zeros((self.num_stages * self.batch_per_slot,), bool)
+        mask[rows] = True
+        self.state = serve_ops.serve_cancel_rows(self.state, jnp.asarray(mask))
+
     def stream(self, req: Request) -> Iterator[int]:
         """Yield ``req``'s generated token ids as they are produced, pumping
         the server. Tokens come one ring cycle at a time from the SHARDED
@@ -253,6 +310,35 @@ class PipelineServer:
             self.step()
 
     # ------------------------------------------------------------ internals
+
+    def _hit_stop(self, req: Request) -> bool:
+        """True if any stop string appears in the decoded generation; on hit,
+        truncates ``req.tokens`` to the minimal prefix whose decoded text
+        contains the stop (token granularity — the triggering token is kept,
+        like EOS; stop strings spanning token boundaries are caught because
+        the check decodes text, not ids).
+
+        Cost is bounded per cycle: only a TAIL WINDOW of new-tokens plus a
+        margin is re-decoded (a watermark tracks what was already scanned),
+        not the whole growing generation — O(total) host work over a
+        request's life instead of O(total²) in the serving loop. The margin
+        covers boundary-spanning stops: a stop of L characters spans at most
+        L tokens that each decode to ≥1 character, plus slack for tokens
+        that decode to empty text (skipped specials)."""
+        tok = self.engine.tokenizer
+        margin = 8 + 2 * max(len(s) for s in req.stop)
+        start = max(0, req.stop_checked - margin)
+        window = req.tokens[start:]
+        req.stop_checked = len(req.tokens)
+        text = tok.decode(window, skip_special_tokens=True)
+        if not any(s in text for s in req.stop):
+            return False
+        for n in range(1, len(window) + 1):
+            t = tok.decode(window[:n], skip_special_tokens=True)
+            if any(s in t for s in req.stop):
+                del req.tokens[start + n:]
+                return True
+        return True
 
     def _bucket(self, n: int) -> int:
         for b in ADMIT_BUCKETS:
@@ -418,10 +504,17 @@ class PipelineServer:
             self.num_stages,
         )
         self._admitting_rows.difference_update(range(row0, row0 + Bs))
+        pending = [
+            r for r in range(row0, row0 + Bs) if r in self._pending_cancels
+        ]
+        if pending:
+            self._cancel_rows(pending)
+            self._pending_cancels.difference_update(pending)
 
     def _fetch(self) -> None:
         lengths = np.asarray(self.state.lengths)
-        done = np.asarray(self.state.done)
+        # writable copy: the stop-sequence branch marks rows done locally
+        done = np.array(self.state.done)
         out = None  # fetched lazily — only when some row progressed
         for row, req in enumerate(self._rows):
             if req is None or req.done or row in self._admitting_rows:
@@ -435,6 +528,13 @@ class PipelineServer:
                     out = np.asarray(self.state.out)
                 req.tokens.extend(int(t) for t in out[row, lo:hi])
                 self.counters.tokens_generated += hi - lo
+                if req.stop and self._hit_stop(req):
+                    # stop string surfaced in the decoded text: truncate to
+                    # the minimal token prefix containing it, stop the row
+                    # on device, and run the completion branch below now
+                    # (the local done copy is updated to match)
+                    self._cancel_rows([row])
+                    done[row] = True
             self._lengths_seen[row] = hi
             if bool(done[row]):
                 req.done = True

@@ -1,0 +1,44 @@
+"""Persistent XLA compilation cache for the operator entry points.
+
+The serving programs compile in tens of seconds on a real chip (first jit
+~20-40s for 3B-class models; the continuous-batching server compiles an
+admit program per bucket plus the chunk program). The reference world pays
+its startup cost in weight loading (`/root/reference/utils/node_worker.py:
+127-185` — measured by `profile_cold_start_latency`); the TPU-native
+equivalent of keeping cold starts cheap is persisting compiled executables
+across processes, so a daemon restart or a repeated bench run reuses every
+program (measured on the v5e tunnel: 1.8 s compile → 0.01 s reload).
+
+Opt out with ``LLM_SHARDING_TPU_CACHE=off`` (or point it at a different
+directory). Safe to call multiple times; must run before the first
+compilation to be useful, so the CLI and bench call it at entry.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_DEFAULT = os.path.join(
+    os.path.expanduser("~"), ".cache", "llm_sharding_tpu", "xla"
+)
+
+
+def enable_persistent_cache(path: Optional[str] = None) -> Optional[str]:
+    """Point JAX's compilation cache at a durable directory. Returns the
+    directory used, or ``None`` when disabled (env ``off``/``0``/empty or an
+    unwritable path — callers proceed uncached rather than fail)."""
+    import jax
+
+    path = path or os.environ.get("LLM_SHARDING_TPU_CACHE", _DEFAULT)
+    if path.lower() in ("", "0", "off", "none"):
+        return None
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError:
+        return None
+    jax.config.update("jax_compilation_cache_dir", path)
+    # the default threshold skips sub-second compiles; 1s keeps tiny-config
+    # test programs out while catching every real model program
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return path

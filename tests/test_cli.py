@@ -315,3 +315,16 @@ def test_launch_two_process_simulation(tmp_path, capsys):
     assert os.path.exists(os.path.join(log_dir, "worker_1.log"))
     with open(os.path.join(log_dir, "worker_1.log")) as f:
         assert "2 processes, 4 global devices" in f.read()
+
+
+def test_compile_cache_toggle(tmp_path, monkeypatch):
+    """Persistent-cache helper: creates/points at the directory, honors the
+    off switch, and tolerates unwritable paths (returns None, never raises)."""
+    import os
+
+    from llm_sharding_tpu.utils.compile_cache import enable_persistent_cache
+
+    p = enable_persistent_cache(str(tmp_path / "xla"))
+    assert p is not None and os.path.isdir(p)
+    monkeypatch.setenv("LLM_SHARDING_TPU_CACHE", "off")
+    assert enable_persistent_cache() is None

@@ -218,3 +218,104 @@ def test_mixed_bucket_requests_not_coadmitted(setup):
     srv.run_until_idle()
     assert r1.tokens == oracle_tokens(params, p_short, 48)
     assert r2.tokens == oracle_tokens(params, p_long, 8)
+
+
+class _FakeTokenizer:
+    """Maps each id to a delimited substring so stop strings are exact."""
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(f"<{int(i)}>" for i in ids)
+
+
+def test_cancel_queued_and_in_flight(setup):
+    """Cancellation (a capability the reference lacks): a queued request
+    leaves the queue; an in-flight request stops producing, its slot frees
+    for re-admission, and co-resident requests stay token-exact."""
+    params, eng = setup
+    srv = eng.serve(capacity=64, batch_per_slot=1)
+    rng = np.random.default_rng(5)
+    pa = rng.integers(1, CFG.vocab_size, 4).astype(np.int32)
+    pb = rng.integers(1, CFG.vocab_size, 3).astype(np.int32)
+
+    # fill all 4 slots so a 5th request queues
+    live = [srv.submit(pa, 40) for _ in range(4)]
+    srv.step()
+    queued = srv.submit(pb, 8)
+    assert queued.row is None
+    assert srv.cancel(queued) and queued.done
+    assert not srv.cancel(queued)  # idempotent
+
+    # cancel one in-flight request mid-decode
+    srv.step()
+    victim = live[1]
+    had = len(victim.tokens)
+    assert srv.cancel(victim) and victim.done
+    # a new request is admitted into the freed slot and completes exactly
+    rc = srv.submit(pb, 8)
+    srv.run_until_idle()
+    assert rc.tokens == oracle_tokens(params, pb, 8)
+    assert len(victim.tokens) <= had + 1  # no growth after cancellation
+    for r in (live[0], live[2], live[3]):
+        assert r.tokens == oracle_tokens(params, pa, 40)
+    assert srv.counters.requests_cancelled == 2
+
+
+def test_stop_sequences_truncate_and_free(setup):
+    """Host-side stop strings: generation stops when the decoded text
+    contains the stop, tokens truncate to the minimal prefix containing it,
+    and the row frees (the follow-up request is served)."""
+    params, eng = setup
+    eng_tok = eng.tokenizer
+    eng.tokenizer = _FakeTokenizer()
+    try:
+        srv = eng.serve(capacity=64, batch_per_slot=1)
+        rng = np.random.default_rng(6)
+        pa = rng.integers(1, CFG.vocab_size, 5).astype(np.int32)
+        full = oracle_tokens(params, pa, 12)
+        assert len(full) >= 4
+        stop_tok = full[2]
+        want = full[: full.index(stop_tok) + 1]  # first occurrence wins
+        ra = srv.submit(pa, 12, stop=[f"<{stop_tok}>"])
+        rb = srv.submit(pa, 12)  # same prompt, no stop: runs to the end
+        srv.run_until_idle()
+        assert ra.tokens == want, (ra.tokens, full)
+        assert ra.done
+        assert rb.tokens == full
+    finally:
+        eng.tokenizer = eng_tok
+
+
+def test_stop_requires_tokenizer(setup):
+    _, eng = setup
+    srv = eng.serve(capacity=64)
+    if eng.tokenizer is None:
+        with pytest.raises(ValueError, match="tokenizer"):
+            srv.submit(np.array([1, 2], np.int32), 4, stop=["x"])
+    with pytest.raises(ValueError, match="stop"):
+        srv.submit(np.array([1, 2], np.int32), 4, stop=[""])
+
+
+def test_cancel_during_chunked_admission_deferred(setup):
+    """cancel() of a row whose slot is mid-chunked-admission must NOT touch
+    the device done flags (serve_admit_finish would overwrite them when it
+    arms the slot) — it defers, and the flag lands after admission finishes
+    (the application lives at the end of _admit_chunked)."""
+    params, eng = setup
+    srv = eng.serve(capacity=64, batch_per_slot=1)
+    rng = np.random.default_rng(9)
+    pa = rng.integers(1, CFG.vocab_size, 4).astype(np.int32)
+    ra = srv.submit(pa, 30)
+    srv.step()
+    row = ra.row
+    srv._admitting_rows.add(row)  # simulate: slot re-entered admission
+    assert srv.cancel(ra) and ra.done
+    assert row in srv._pending_cancels
+    assert not bool(np.asarray(srv.state.done)[row]), (
+        "device done set while the slot was mid-admission"
+    )
+    # what _admit_chunked's tail does once serve_admit_finish ran:
+    srv._admitting_rows.discard(row)
+    srv._cancel_rows([row])
+    srv._pending_cancels.discard(row)
+    assert bool(np.asarray(srv.state.done)[row])
+    srv.run_until_idle()
