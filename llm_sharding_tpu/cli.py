@@ -258,7 +258,9 @@ def cmd_serve(args) -> int:
             srv = _serve_control(eng, srv, prompt, args)
             continue
         ids = np.asarray(tok(prompt)["input_ids"], np.int32)
-        req = srv.submit(ids, args.max_new, temperature=args.temperature)
+        req = srv.submit(
+            ids, args.max_new, temperature=args.temperature, stop=args.stop
+        )
         acc: list[int] = []
         prev = ""
         for t in srv.stream(req):
@@ -563,6 +565,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--temperature", type=float, default=0.0)
     s.add_argument("--top-k", type=int, default=0, dest="top_k")
     s.add_argument("--top-p", type=float, default=1.0, dest="top_p")
+    s.add_argument(
+        "--stop", action="append", default=None,
+        help="stop string (repeatable): generation ends when the decoded "
+        "text contains it",
+    )
     s.set_defaults(fn=cmd_serve)
 
     w = sub.add_parser(

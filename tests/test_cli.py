@@ -328,3 +328,34 @@ def test_compile_cache_toggle(tmp_path, monkeypatch):
     assert p is not None and os.path.isdir(p)
     monkeypatch.setenv("LLM_SHARDING_TPU_CACHE", "off")
     assert enable_persistent_cache() is None
+
+
+def test_serve_command_stop_flag(shards, capsys, monkeypatch):
+    """--stop plumbs through to submit(): the daemon serves with a stop
+    string configured (the string check itself is pinned in
+    tests/test_serve.py::test_stop_sequences_truncate_and_free)."""
+    from llm_sharding_tpu.runtime import engine as engine_mod
+
+    tok = IdTokenizer()
+    monkeypatch.setattr(
+        engine_mod.PipelineEngine, "_require_tokenizer", lambda self: tok
+    )
+    orig = engine_mod.PipelineEngine.from_shards.__func__
+
+    def patched(cls, *a, **k):
+        eng = orig(cls, *a, **k)
+        eng.tokenizer = tok  # server-side stop check reads engine.tokenizer
+        return eng
+
+    monkeypatch.setattr(
+        engine_mod.PipelineEngine, "from_shards", classmethod(patched)
+    )
+    monkeypatch.setattr("sys.stdin", io.StringIO("hi\n"))
+    rc = cli.main(
+        [
+            "serve", shards, "--max-new", "4", "--stages", "4",
+            "--capacity", "64", "--dtype", "f32", "--stop", "0",
+        ]
+    )
+    assert rc == 0
+    assert '"requests_completed": 1' in capsys.readouterr().err
