@@ -113,19 +113,12 @@ def cmd_convert(args) -> int:
 def cmd_generate(args) -> int:
     eng = _engine(args)
     if args.stream:
-        # streaming goes through the shared continuous-batching server, whose
-        # top-k/top-p are server-level statics — per-request temperature/seed
-        # apply; non-default top-k/top-p need the non-streaming path
-        # (`!= 1.0`, not `< 1.0`: an out-of-range value like 1.5 must be
-        # rejected here too, not silently stream unfiltered)
-        if args.top_k or args.top_p != 1.0:
-            raise SystemExit(
-                "--stream supports --temperature/--seed only (top-k/top-p "
-                "are server-level; drop --stream or the top-k/top-p flags)"
-            )
+        # streaming goes through the shared continuous-batching server;
+        # temperature/seed/top-k/top-p are all per-request row state there
         for delta in eng.generate_text_stream(
             args.prompt, args.max_new,
             temperature=args.temperature, seed=args.seed,
+            top_k=args.top_k, top_p=args.top_p,
         ):
             print(delta, end="", flush=True)
         print()
@@ -250,6 +243,7 @@ def cmd_serve(args) -> int:
         file=sys.stderr,
     )
     tok = eng._require_tokenizer()
+    n_prompt = 0
     for line in sys.stdin:
         prompt = line.rstrip("\n")
         if not prompt:
@@ -258,9 +252,13 @@ def cmd_serve(args) -> int:
             srv = _serve_control(eng, srv, prompt, args)
             continue
         ids = np.asarray(tok(prompt)["input_ids"], np.int32)
+        # per-request seed advances from --seed so two identical sampled
+        # prompts in one session draw different completions (ADVICE r3 #3)
         req = srv.submit(
-            ids, args.max_new, temperature=args.temperature, stop=args.stop
+            ids, args.max_new, temperature=args.temperature,
+            seed=args.seed + n_prompt, stop=args.stop,
         )
+        n_prompt += 1
         acc: list[int] = []
         prev = ""
         for t in srv.stream(req):
@@ -563,6 +561,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     s.add_argument("--dtype", default="bf16")
     s.add_argument("--temperature", type=float, default=0.0)
+    s.add_argument(
+        "--seed", type=int, default=0,
+        help="base sampling seed; each submitted prompt advances it by one",
+    )
     s.add_argument("--top-k", type=int, default=0, dest="top_k")
     s.add_argument("--top-p", type=float, default=1.0, dest="top_p")
     s.add_argument(

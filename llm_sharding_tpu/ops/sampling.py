@@ -31,18 +31,37 @@ def validate_top_p(top_p) -> float:
     return top_p
 
 
-def top_p_threshold(scaled: jnp.ndarray, top_p: float) -> jnp.ndarray:
+def top_p_threshold(scaled, top_p, presorted: bool = False) -> jnp.ndarray:
     """Nucleus threshold: ``[B, V]`` temperature-scaled (possibly already
     top-k-masked) logits → ``[B, 1]`` smallest logit kept by top-p filtering
-    (HF semantics: the smallest set of highest-probability tokens whose
+    (HF-style: the smallest set of highest-probability tokens whose
     cumulative probability reaches ``top_p``; the most-likely token is always
     kept). ``-inf`` columns (top-k mask, vocab padding in the sharded head)
     carry zero probability and never affect the threshold, which is why the
     sharded gather-then-threshold path is bitwise equal to the monolith's
-    (``parallel/head.sp_sample``)."""
-    desc = -jnp.sort(-scaled, axis=-1)  # descending
+    (``parallel/head.sp_sample``).
+
+    Tie behavior (ADVICE r3 #1): the returned value is applied as a VALUE
+    threshold (``scaled < thresh`` masks), so every token whose logit ties
+    the nucleus-boundary logit is kept — the kept set can exceed HF's
+    ``TopPLogitsWarper``, which masks by sorted POSITION and drops
+    boundary-tied duplicates beyond the cutoff index. Value-threshold
+    semantics are deliberate: they are what makes the vocab-sharded
+    reproduction exact without shipping sort permutations between stages
+    (ties are measure-zero for real logits; for parity tests use logit
+    tensors without boundary ties).
+
+    ``top_p`` may be a scalar or per-row ``[B]``/``[B, 1]`` array (the
+    serving path's dynamic per-request values). ``presorted=True`` skips the
+    sort when the caller already holds the descending distribution — this is
+    the ONE nucleus definition every path shares (the sharded per-row
+    sampler calls it on its gathered sorted array)."""
+    desc = scaled if presorted else -jnp.sort(-scaled, axis=-1)  # descending
     probs = jax.nn.softmax(desc, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
+    top_p = jnp.asarray(top_p)
+    if top_p.ndim == 1:
+        top_p = top_p[:, None]
     keep = (cum - probs) < top_p  # cumulative mass BEFORE each token
     return jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True)
 

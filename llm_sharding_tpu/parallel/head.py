@@ -333,24 +333,62 @@ def sp_sample_rows(
     h_last: jnp.ndarray,  # [B, H] replicated
     row_keys: jnp.ndarray,  # [B, 2] raw uint32 key data, one chain per row
     temperature: jnp.ndarray,  # [B] f32; <= 0 → greedy for that row
-    top_k: int,  # static (server-level)
+    top_k: jnp.ndarray,  # [B] int32; 0 → no top-k for that row
+    top_p: jnp.ndarray,  # [B] f32; 1.0 → no top-p for that row
     num_stages: int,  # static
-    top_p: float = 1.0,  # static (server-level)
+    filtering: bool = True,  # static: compile the top-k/top-p machinery
 ) -> jnp.ndarray:
     """Per-row seeded sampling (the serving path: each slot row carries its
-    own request's key chain and temperature). A row with temperature t>0 and
-    key chain seeded like the monolith's draws the monolith's B=1 tokens
-    exactly; t<=0 rows are greedy."""
+    own request's key chain, temperature, top-k and top-p — ALL dynamic, so
+    per-request values never recompile the decode program). A row with
+    temperature t>0 and a key chain seeded like the monolith's draws the
+    monolith's B=1 tokens exactly, including its top-k/top-p filters.
+
+    ``filtering=False`` statically compiles the filters OUT (no vocab
+    gather, no sort) — the caller flips it the first time a request with
+    top_k>0 or top_p<1 arrives, the same one-extra-compile pattern as the
+    serve path's ``sampling`` flag. With it on:
+
+    Both filters derive per-row VALUE thresholds from one gathered,
+    descending-sorted full distribution ([B, Vp] fp32 — ~0.5 MB at V=128k,
+    negligible next to the matmuls):
+
+    - top-k: the k-th largest element — bitwise the monolith's
+      ``lax.top_k(scaled, k)[0][:, -1]``;
+    - top-p: the monolith's ``top_p_threshold`` (the shared nucleus
+      definition, called with ``presorted=True``) over the post-top-k
+      distribution, reproduced by VALUE-masking the sorted array at the
+      top-k threshold (not position-masking at k), so duplicate logits tied
+      at the k-th value survive into the nucleus exactly as they do in the
+      monolith's sequential masking.
+
+    Masking ``scaled < max(kth, pth)`` then equals the monolith's two
+    sequential maskings (both are value thresholds on the same array)."""
+    from ..ops.sampling import top_p_threshold
+
     logits, lo = _local_logits(cfg, head, h_last)
     greedy = _assemble_argmax(logits, lo)
 
     safe_t = jnp.where(temperature > 0, temperature, 1.0)
     scaled = logits / safe_t[:, None]
-    if top_k > 0:
-        kth = _topk_threshold(scaled, top_k)
-        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
-    if top_p < 1.0:
-        scaled = _topp_filter(scaled, top_p)
+
+    if filtering:
+        allv = jax.lax.all_gather(scaled, PIPE_AXIS)  # [S, B, Vs]
+        full = jnp.transpose(allv, (1, 0, 2)).reshape(allv.shape[1], -1)
+        desc = -jnp.sort(-full, axis=-1)  # [B, Vp] descending
+        Vp = desc.shape[-1]
+
+        k_idx = jnp.clip(top_k - 1, 0, Vp - 1)
+        kth = jnp.take_along_axis(desc, k_idx[:, None], axis=-1)  # [B, 1]
+        kth = jnp.where((top_k > 0)[:, None], kth, -jnp.inf)
+
+        # value mask keeps k-th-value ties; still descending → presorted
+        desc_k = jnp.where(desc < kth, -jnp.inf, desc)
+        pth = top_p_threshold(desc_k, top_p, presorted=True)
+        pth = jnp.where((top_p < 1.0)[:, None], pth, -jnp.inf)
+
+        thresh = jnp.maximum(kth, pth)
+        scaled = jnp.where(scaled < thresh, -jnp.inf, scaled)
     # per-row noise: gumbel(key, (1, V)) row-reshaped == gumbel(key, (V,)),
     # so each row reproduces a B=1 monolith draw
     g_full = jax.vmap(

@@ -98,13 +98,15 @@ def mesh_axis_sizes(mesh: Mesh) -> tuple[int, int, int]:
     )
 
 
-def stage_layer_specs(cfg: ModelConfig, tp: int):
+def stage_layer_specs(cfg: ModelConfig, tp: int, stage_layers: Any = None):
     """shard_map in_specs for the [num_stages, Lp, ...] stage arrays: pipe on
     the leading axis; with tensor parallelism, megatron column/row sharding on
     the weight dims (specs from ``tensor.*_tp_specs`` shifted under the two
     leading stack axes). gpt2's fused qkv is column-permuted by
     ``pipeline_generate`` itself so each shard's slice is a head-aligned
-    (q, k, v) triple."""
+    (q, k, v) triple. int8 ``QTensor`` leaves (detected from
+    ``stage_layers``) get per-component specs — q sharded like the raw
+    weight, scale on the output axis (``tensor.quant_leaf_spec``)."""
     if tp == 1:
         return P(PIPE_AXIS)  # pytree-prefix spec: applies to every leaf
     if cfg.model_type == "llama":
@@ -117,7 +119,15 @@ def stage_layer_specs(cfg: ModelConfig, tp: int):
         per_leaf = gpt2_tp_specs(stacked=False)["layers"]
     else:
         raise NotImplementedError(f"pp×tp: {cfg.model_type!r} unsupported")
-    return {k: P(PIPE_AXIS, None, *s) for k, s in per_leaf.items()}
+    from .tensor import quant_leaf_spec
+
+    return {
+        k: quant_leaf_spec(
+            P(PIPE_AXIS, None, *s),
+            None if stage_layers is None else stage_layers.get(k),
+        )
+        for k, s in per_leaf.items()
+    }
 
 
 def _tree_where(pred, new, old):
@@ -209,6 +219,7 @@ def _pipeline_generate_jit(
     prompt: jnp.ndarray,  # [B, S]
     prompt_len: jnp.ndarray,  # [B]
     rng: jnp.ndarray,  # [2] raw uint32 key data (replicated)
+    prompt_embeds: Optional[jnp.ndarray],  # [B, S, H] or None (token entry)
     num_stages: int,
     max_new_tokens: int,
     capacity: int,
@@ -230,7 +241,8 @@ def _pipeline_generate_jit(
     Nkv_local = cfg.num_key_value_heads // tp
     ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
 
-    def body(stage_layers, layer_mask, head_params, prompt, prompt_len, rng):
+    def body(stage_layers, layer_mask, head_params, prompt, prompt_len, rng,
+             prompt_embeds):
         # Local views: shard_map gives leading stage dim of 1 — drop it.
         layers = jax.tree.map(lambda a: a[0], stage_layers)
         mask = layer_mask[0]
@@ -269,7 +281,16 @@ def _pipeline_generate_jit(
         positions = jnp.where(
             idx[None, :] < prompt_len[:, None], idx[None, :], POS_SENTINEL
         )
-        h = sp_embed(cfg, hd, prompt, positions)
+        if prompt_embeds is None:
+            h = sp_embed(cfg, hd, prompt, positions)
+        else:
+            # Privacy entry (≙ the reference's request-injection channel,
+            # node_worker.py:476-491): the caller embedded host-side
+            # (engine.embed_prompt); raw token ids never enter the program.
+            # Pad positions carry caller zeros instead of pad-token
+            # embeddings — both are sentinel-masked out of attention, so
+            # decoding is token-exact vs the ids path.
+            h = prompt_embeds
         h, cache = chain(h, cache, positions)
         # The fully-processed block has landed back on stage 0; pull its
         # last real position and broadcast so every stage can project its
@@ -334,16 +355,18 @@ def _pipeline_generate_jit(
         body,
         mesh=mesh,
         in_specs=(
-            stage_layer_specs(cfg, tp),
+            stage_layer_specs(cfg, tp, stage_layers),
             P(PIPE_AXIS),
             head_specs(head_params),
             batch_spec,
             batch_spec,
             P(),
+            batch_spec,  # no-op when prompt_embeds is None (leafless pytree)
         ),
         out_specs=(batch_spec, batch_spec),
         check_vma=False,
-    )(stage_layers, layer_masks, head_params, prompt, prompt_len, rng)
+    )(stage_layers, layer_masks, head_params, prompt, prompt_len, rng,
+      prompt_embeds)
     return out, lengths
 
 
@@ -363,14 +386,46 @@ def pipeline_generate(
     top_k: int = 0,
     top_p: float = 1.0,
     seed: int = 0,
+    prompt_embeds=None,  # [B, S, H]: privacy entry — ids never enter
 ) -> PipelineResult:
     """Pipelined generation across the mesh (host-facing entry). Greedy by
     default; ``temperature``/``top_k``/``top_p``/``seed`` sample token-exactly
     vs the monolithic ``runtime.generate`` (r2 weak #8 — one sampling surface
-    for every path)."""
+    for every path).
+
+    ``prompt_embeds`` is the embeddings-in privacy entry (≙ the reference's
+    request-injection channel: any embedding-capable node embeds locally and
+    injects post-embedding hidden states, so raw text/ids never leave it —
+    ``/root/reference/utils/node_worker.py:476-491``, ``README.md:17``).
+    Pass ``engine.embed_prompt(ids)`` (or any [B, S, H] hidden states) and a
+    ``prompt_len``; ``prompt_ids`` then only sizes the output buffer — pass
+    zeros. Token-exact vs the ids path (tests/test_pipeline.py)."""
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
     if prompt_ids.ndim == 1:
         prompt_ids = prompt_ids[None]
+    if prompt_embeds is not None:
+        prompt_embeds = jnp.asarray(prompt_embeds)
+        if prompt_embeds.ndim == 2:
+            prompt_embeds = prompt_embeds[None]
+        if (
+            prompt_embeds.shape[:2] != tuple(prompt_ids.shape)
+            or prompt_embeds.shape[-1] != cfg.hidden_size
+        ):
+            raise ValueError(
+                f"prompt_embeds {prompt_embeds.shape} does not match "
+                f"[{prompt_ids.shape[0]}, {prompt_ids.shape[1]}, "
+                f"{cfg.hidden_size}]"
+            )
+        # cast to the stage activation dtype: fp32 embeds on a bf16 model
+        # would run prefill at a different precision than the ids path and
+        # could flip greedy ties, breaking the token-exactness contract
+        from ..ops.quant import QTensor
+
+        leaf = jax.tree.leaves(
+            stage_layers, is_leaf=lambda x: isinstance(x, QTensor)
+        )[0]
+        act_dtype = leaf.scale.dtype if isinstance(leaf, QTensor) else leaf.dtype
+        prompt_embeds = prompt_embeds.astype(act_dtype)
     B, S = prompt_ids.shape
     if prompt_len is None:
         prompt_len = jnp.full((B,), S, jnp.int32)
@@ -384,15 +439,9 @@ def pipeline_generate(
 
     dp, _, tp = mesh_axis_sizes(mesh)
     if tp > 1:
-        from ..ops.quant import is_quantized
         from .tensor import validate_tp
 
         validate_tp(cfg, tp)
-        if is_quantized(stage_layers):
-            raise NotImplementedError(
-                "tensor parallelism over int8-quantized weights is not "
-                "supported yet (QTensor leaves need per-component specs)"
-            )
         if cfg.model_type == "gpt2":
             # fused-qkv column permutation happens HERE, not as a caller
             # precondition — callers pass raw layers and can neither forget
@@ -418,6 +467,8 @@ def pipeline_generate(
         prompt_ids = put_global(prompt_ids, sh)
         prompt_len = put_global(prompt_len, sh)
         rng = put_global(rng, NamedSharding(mesh, P()))
+        if prompt_embeds is not None:
+            prompt_embeds = put_global(prompt_embeds, sh)
     out, lengths = _pipeline_generate_jit(
         cfg,
         mesh,
@@ -427,6 +478,7 @@ def pipeline_generate(
         prompt_ids,
         prompt_len,
         rng,
+        prompt_embeds,
         num_stages,
         max_new_tokens,
         capacity,

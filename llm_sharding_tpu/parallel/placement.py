@@ -97,6 +97,28 @@ class PlacementSpec:
     ) -> "PlacementSpec":
         return cls(tuple(ranges), num_layers)
 
+    def grouped(self, k: int) -> "PlacementSpec":
+        """Merge ``k`` consecutive chain stages per device — the execution
+        spec for a chain LONGER than the pipe axis (≙ the reference running
+        multiple controllers per host: a 4-stage chain over 3 machines,
+        ``/root/reference/send_config.py:36-44`` — chain length is a
+        placement property, not a hardware one). Each device runs its k
+        stage-slices back to back (they are consecutive in chain order, so
+        the hop between them is local — the scan over the merged layer stack
+        IS the 'scan over the extra stage dim'), and the ring permute fires
+        once per k virtual stages. Stages are contiguous layer ranges, so
+        each merged group is itself a contiguous range: execution is
+        token-identical to the virtual chain by construction."""
+        if k < 1 or self.num_stages % k:
+            raise ValueError(
+                f"{self.num_stages} stages cannot group by {k} per device"
+            )
+        merged = tuple(
+            (self.stages[i * k][0], self.stages[i * k + k - 1][1])
+            for i in range(self.num_stages // k)
+        )
+        return PlacementSpec(merged, self.num_layers)
+
     @classmethod
     def from_capabilities(
         cls, num_layers: int, capabilities: Sequence[float]

@@ -70,7 +70,7 @@ class InterleavedResult(NamedTuple):
     jax.jit,
     static_argnames=(
         "cfg", "mesh", "num_stages", "max_new_tokens", "capacity",
-        "cache_dtype", "top_k", "top_p", "sampling",
+        "cache_dtype", "sampling", "filtering",
     ),
 )
 def _interleaved_jit(
@@ -84,13 +84,14 @@ def _interleaved_jit(
     slot_valid: jnp.ndarray,  # [M] bool — False for padding rows
     temperature: jnp.ndarray,  # [M] f32; <= 0 → greedy for that row
     seeds: jnp.ndarray,  # [M] int32 per-row sampling seeds
+    topk: jnp.ndarray,  # [M] int32; 0 → no top-k for that row
+    topp: jnp.ndarray,  # [M] f32; 1.0 → no top-p for that row
     num_stages: int,
     max_new_tokens: int,
     capacity: int,
     cache_dtype,
-    top_k: int,
-    top_p: float,
     sampling: bool,
+    filtering: bool,
 ):
     fns = model_fns(cfg)
     M, S = prompts.shape
@@ -101,7 +102,7 @@ def _interleaved_jit(
     last = num_stages - 1
 
     def body(stage_layers, layer_mask, head_params, prompts, prompt_len,
-             slot_valid, temperature, seeds):
+             slot_valid, temperature, seeds, topk, topp):
         layers = jax.tree.map(lambda a: a[0], stage_layers)
         lmask = layer_mask[0]
         hd = local_view(head_params)
@@ -133,7 +134,8 @@ def _interleaved_jit(
             # sample) — the SAME shared helpers as the serve path
             row_keys, subs = seed_chain_init(seeds)  # [M, 2] each
             tok0 = sp_sample_rows(
-                cfg, hd, h_last, subs, temperature, top_k, num_stages, top_p
+                cfg, hd, h_last, subs, temperature, topk, topp, num_stages,
+                filtering=filtering,
             )
         else:
             row_keys = jnp.zeros((M, 2), jnp.uint32)
@@ -239,8 +241,11 @@ def _interleaved_jit(
                 )
                 new_keys, subs = key_chain_split(rng_rows)
                 temp_rows = jax.lax.dynamic_slice_in_dim(temperature, rowd, Bs)
+                topk_rows = jax.lax.dynamic_slice_in_dim(topk, rowd, Bs)
+                topp_rows = jax.lax.dynamic_slice_in_dim(topp, rowd, Bs)
                 nxt = sp_sample_rows(
-                    cfg, hd, h_done, subs, temp_rows, top_k, num_stages, top_p
+                    cfg, hd, h_done, subs, temp_rows, topk_rows, topp_rows,
+                    num_stages, filtering=filtering,
                 )
             else:
                 nxt = sp_next_token(cfg, hd, h_done)  # [Bs], replicated
@@ -303,11 +308,13 @@ def _interleaved_jit(
             P(),
             P(),
             P(),
+            P(),
+            P(),
         ),
         out_specs=(P(), P()),
         check_vma=False,
     )(stage_layers, layer_masks, head_params, prompts, prompt_len, slot_valid,
-      temperature, seeds)
+      temperature, seeds, topk, topp)
     return out, lengths
 
 
@@ -325,16 +332,17 @@ def interleaved_generate(
     batch_per_slot: Optional[int] = None,
     cache_dtype=jnp.bfloat16,
     temperature=0.0,  # scalar or per-request [R]; <= 0 → greedy
-    top_k: int = 0,
-    top_p: float = 1.0,
+    top_k=0,  # scalar or per-request [R]; 0 → off
+    top_p=1.0,  # scalar or per-request [R]; 1.0 → off
     seeds=None,  # per-request sampling seeds [R] (default zeros)
 ) -> InterleavedResult:
     """Generate for up to ``num_stages * batch_per_slot`` requests
     concurrently, pipeline full. ``batch_per_slot`` defaults to the smallest
     value that fits all R requests. Sampling is per-row: request r with
     ``temperature[r] > 0`` draws the B=1 monolithic ``generate(...,
-    temperature, top_k, seed=seeds[r])`` tokens exactly (the same key-chain
-    contract as the serve path)."""
+    temperature, top_k, top_p, seed=seeds[r])`` tokens exactly (the same
+    key-chain contract as the serve path). ``top_k``/``top_p`` are dynamic
+    per-row values — mixed filter settings share one compiled program."""
     prompts = jnp.asarray(prompts, jnp.int32)
     if prompts.ndim == 1:
         prompts = prompts[None]
@@ -371,9 +379,21 @@ def interleaved_generate(
     seed_arr = np.zeros((M,), np.int32)
     if seeds is not None:
         seed_arr[:R] = np.broadcast_to(np.asarray(seeds, np.int32), (R,))
+    topk_arr = np.zeros((M,), np.int32)
+    topk_arr[:R] = np.broadcast_to(np.asarray(top_k, np.int32), (R,))
+    topp_arr = np.ones((M,), np.float32)
+    topp_arr[:R] = np.broadcast_to(
+        np.asarray([validate_top_p(p) for p in np.atleast_1d(top_p)],
+                   np.float32),
+        (R,),
+    )
     # top_k alone cannot change an argmax, so all-greedy batches compile the
-    # plain greedy program regardless of top_k
+    # plain greedy program regardless of top_k; likewise the filter
+    # machinery (vocab gather + sort) compiles in only when some row uses it
     sampling = bool(np.any(temps > 0))
+    filtering = sampling and bool(
+        np.any((topk_arr > 0) | (topp_arr < 1.0))
+    )
 
     out, lengths = _interleaved_jit(
         cfg,
@@ -386,12 +406,13 @@ def interleaved_generate(
         jnp.asarray(slot_valid),
         jnp.asarray(temps),
         jnp.asarray(seed_arr),
+        jnp.asarray(topk_arr),
+        jnp.asarray(topp_arr),
         num_stages,
         max_new_tokens,
         capacity,
         cache_dtype,
-        int(top_k),
-        validate_top_p(top_p),
         sampling,
+        filtering,
     )
     return InterleavedResult(np.asarray(out)[:R], np.asarray(lengths)[:R])

@@ -73,6 +73,8 @@ class ServeState(NamedTuple):
     inject_pending: jax.Array  # [M] bool
     rng: jax.Array        # [M, 2] raw uint32 PRNG key data, one chain per row
     temp: jax.Array       # [M] f32 sampling temperature (<= 0 → greedy)
+    topk: jax.Array       # [M] int32 per-row top-k (0 → off)
+    topp: jax.Array       # [M] f32 per-row top-p (1.0 → off)
     m: jax.Array          # scalar int32 microstep counter
 
 
@@ -82,7 +84,8 @@ def state_specs(state: ServeState) -> ServeState:
     return ServeState(
         k=dev, v=dev, kpos=dev, h=dev, h_valid=dev, pos_slots=dev,
         write_off=dev, out=rep, lengths=rep, done=rep, budget=rep,
-        inject=rep, inject_pending=rep, rng=rep, temp=rep, m=rep,
+        inject=rep, inject_pending=rep, rng=rep, temp=rep, topk=rep,
+        topp=rep, m=rep,
     )
 
 
@@ -149,6 +152,8 @@ def make_state(
         inject_pending=put(np.zeros((M,), np.bool_), rep),
         rng=put(np.zeros((M, 2), np.uint32), rep),
         temp=put(np.zeros((M,), np.float32), rep),
+        topk=put(np.zeros((M,), np.int32), rep),
+        topp=put(np.ones((M,), np.float32), rep),
         m=put(np.zeros((), np.int32), rep),
     )
     return state
@@ -166,7 +171,7 @@ def serve_cancel_rows(state: ServeState, rows_mask: jnp.ndarray) -> ServeState:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "mesh", "num_stages", "cache_dtype", "top_k", "top_p"),
+    static_argnames=("cfg", "mesh", "num_stages", "cache_dtype", "filtering"),
 )
 def serve_admit(
     cfg: ModelConfig,
@@ -182,20 +187,29 @@ def serve_admit(
     max_new: jnp.ndarray,     # [Bs] per-row new-token budget
     seeds: jnp.ndarray,       # [Bs] int32 per-request sampling seeds
     temperature: jnp.ndarray,  # [Bs] f32; <= 0 → greedy for that row
+    top_k: jnp.ndarray,       # [Bs] int32 per-request top-k (0 → off)
+    top_p: jnp.ndarray,       # [Bs] f32 per-request top-p (1.0 → off)
     num_stages: int,
     cache_dtype,
-    top_k: int = 0,
-    top_p: float = 1.0,
+    prompt_embeds: Any = None,  # [Bs, Sp, H]: privacy entry — ids never enter
+    filtering: bool = True,  # static: compile top-k/top-p machinery
 ):
     """Prefill ``slot`` with up to Bs new requests while the rest of the
-    pipeline state is parked. Returns the updated state."""
+    pipeline state is parked. Returns the updated state.
+
+    With ``prompt_embeds`` the admission skips the vocab-parallel embedding
+    lookup and enters the ring with caller-provided hidden states (≙ the
+    reference's request-injection channel, ``node_worker.py:476-491`` — raw
+    text/ids never leave the node that accepted the request); ``prompts``
+    then only fills the replicated out buffer — pass zeros."""
     fns = model_fns(cfg)
     Bs, Sp = prompts.shape
     ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
     C = state.out.shape[1]
 
     def body(stage_layers, layer_mask, head_params, state, prompts,
-             prompt_len, row_valid, slot, max_new, seeds, temperature):
+             prompt_len, row_valid, slot, max_new, seeds, temperature,
+             top_k, top_p, prompt_embeds):
         layers = jax.tree.map(lambda a: a[0], stage_layers)
         lmask = layer_mask[0]
         hd = local_view(head_params)
@@ -219,7 +233,10 @@ def serve_admit(
         positions = jnp.where(
             idx[None, :] < prompt_len[:, None], idx[None, :], POS_SENTINEL
         )
-        h = sp_embed(cfg, hd, prompts, positions)
+        if prompt_embeds is None:
+            h = sp_embed(cfg, hd, prompts, positions)
+        else:
+            h = prompt_embeds
         h, cache = ring_chain(
             fns, cfg, layers, lmask, sidx, ring, num_stages, h, cache, positions
         )
@@ -232,7 +249,8 @@ def serve_admit(
         # B=1 tokens exactly (r2 weak #8).
         row_keys, subs = seed_chain_init(seeds)  # [Bs, 2] each
         tok0 = sp_sample_rows(
-            cfg, hd, h_last, subs, temperature, top_k, num_stages, top_p
+            cfg, hd, h_last, subs, temperature, top_k, top_p, num_stages,
+            filtering=filtering,
         )  # [Bs] replicated
         tok0 = jnp.where(row_valid, tok0, 0)
 
@@ -276,6 +294,12 @@ def serve_admit(
         temp = jax.lax.dynamic_update_slice_in_dim(
             st.temp, jnp.where(row_valid, temperature, 0.0), row0, axis=0
         )
+        topk = jax.lax.dynamic_update_slice_in_dim(
+            st.topk, jnp.where(row_valid, top_k, 0), row0, axis=0
+        )
+        topp = jax.lax.dynamic_update_slice_in_dim(
+            st.topp, jnp.where(row_valid, top_p, 1.0), row0, axis=0
+        )
 
         # Defense in depth vs stale parked blocks: the device whose next
         # microstep serves this slot currently holds a block belonging to it
@@ -288,7 +312,7 @@ def serve_admit(
             k=k_new, v=v_new, kpos=kpos_new, pos_slots=pos_slots,
             write_off=write_off, out=out, lengths=lengths, budget=budget,
             done=done, inject=inject, inject_pending=inject_pending,
-            h_valid=h_valid, rng=rng, temp=temp,
+            h_valid=h_valid, rng=rng, temp=temp, topk=topk, topp=topp,
         )
         return jax.tree.map(
             lambda spec, leaf: leaf[None] if spec == P(PIPE_AXIS) else leaf,
@@ -301,12 +325,14 @@ def serve_admit(
         mesh=mesh,
         in_specs=(
             P(PIPE_AXIS), P(PIPE_AXIS), head_specs(head_params), specs,
-            P(), P(), P(), P(), P(), P(), P(),
+            P(), P(), P(), P(), P(), P(), P(), P(), P(),
+            P(),  # no-op when prompt_embeds is None (leafless pytree)
         ),
         out_specs=specs,
         check_vma=False,
     )(stage_layers, layer_masks, head_params, state, prompts, prompt_len,
-      row_valid, slot, max_new, seeds, temperature)
+      row_valid, slot, max_new, seeds, temperature, top_k, top_p,
+      prompt_embeds)
     return out_state
 
 
@@ -423,6 +449,8 @@ def serve_admit_finish(
     max_new: jnp.ndarray,     # [Bs]
     seeds: jnp.ndarray,       # [Bs] int32
     temperature: jnp.ndarray,  # [Bs] f32
+    top_k: jnp.ndarray,       # [Bs] int32 (0 → off)
+    top_p: jnp.ndarray,       # [Bs] f32 (1.0 → off)
     num_stages: int,
 ):
     """Arm a chunk-prefilled slot: park each row's final prompt token in the
@@ -438,7 +466,7 @@ def serve_admit_finish(
     Bs = last_tok.shape[0]
 
     def body(head_params, state, last_tok, prompt_len, row_valid, slot,
-             max_new, seeds, temperature):
+             max_new, seeds, temperature, top_k, top_p):
         hd = local_view(head_params)
         sidx = jax.lax.axis_index(PIPE_AXIS)
         st = jax.tree.map(
@@ -476,6 +504,12 @@ def serve_admit_finish(
         temp = jax.lax.dynamic_update_slice_in_dim(
             st.temp, jnp.where(row_valid, temperature, 0.0), row0, axis=0
         )
+        topk = jax.lax.dynamic_update_slice_in_dim(
+            st.topk, jnp.where(row_valid, top_k, 0), row0, axis=0
+        )
+        topp = jax.lax.dynamic_update_slice_in_dim(
+            st.topp, jnp.where(row_valid, top_p, 1.0), row0, axis=0
+        )
         # same stale-parked-block defense as serve_admit
         next_served = jnp.mod(st.m - sidx, num_stages)
         h_valid = jnp.where(next_served == slot, False, st.h_valid)
@@ -483,7 +517,7 @@ def serve_admit_finish(
         new = st._replace(
             pos_slots=pos_slots, lengths=lengths, budget=budget, done=done,
             inject=inject, inject_pending=inject_pending, rng=rng, temp=temp,
-            h_valid=h_valid,
+            topk=topk, topp=topp, h_valid=h_valid,
         )
         return jax.tree.map(
             lambda spec, leaf: leaf[None] if spec == P(PIPE_AXIS) else leaf,
@@ -496,18 +530,18 @@ def serve_admit_finish(
         mesh=mesh,
         in_specs=(
             head_specs(head_params), specs,
-            P(), P(), P(), P(), P(), P(), P(),
+            P(), P(), P(), P(), P(), P(), P(), P(), P(),
         ),
         out_specs=specs,
         check_vma=False,
     )(head_params, state, last_tok, prompt_len, row_valid, slot, max_new,
-      seeds, temperature)
+      seeds, temperature, top_k, top_p)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "cfg", "mesh", "num_stages", "n_micro", "top_k", "top_p", "sampling",
+        "cfg", "mesh", "num_stages", "n_micro", "sampling", "filtering",
     ),
 )
 def serve_chunk(
@@ -519,9 +553,8 @@ def serve_chunk(
     state: ServeState,
     num_stages: int,
     n_micro: int,
-    top_k: int = 0,
-    top_p: float = 1.0,
     sampling: bool = False,
+    filtering: bool = True,
 ):
     """Run ``n_micro`` interleaved microsteps on the live state.
 
@@ -616,8 +649,11 @@ def serve_chunk(
                 )
                 new_keys, subs = key_chain_split(rng_rows)
                 temp_rows = jax.lax.dynamic_slice_in_dim(s.temp, rowd, Bs)
+                topk_rows = jax.lax.dynamic_slice_in_dim(s.topk, rowd, Bs)
+                topp_rows = jax.lax.dynamic_slice_in_dim(s.topp, rowd, Bs)
                 nxt = sp_sample_rows(
-                    cfg, hd, h_done, subs, temp_rows, top_k, num_stages, top_p
+                    cfg, hd, h_done, subs, temp_rows, topk_rows, topp_rows,
+                    num_stages, filtering=filtering,
                 )
             else:
                 nxt = sp_next_token(cfg, hd, h_done)

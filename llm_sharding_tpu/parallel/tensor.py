@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.config import ModelConfig
+from ..ops.quant import QTensor
 
 TENSOR_AXIS = "tensor"
 
@@ -93,6 +94,37 @@ def gpt2_tp_specs(stacked: bool = True) -> dict[str, P]:
     }
 
 
+def quant_leaf_spec(spec: P, leaf):
+    """Per-component PartitionSpec for a maybe-quantized leaf (VERDICT r3
+    next-#4: int8 × TP). A ``QTensor`` weight ``[.., in, out]`` carries a
+    ``[.., out]`` scale: ``q`` shards exactly like the raw weight, and the
+    scale drops the contracted (``in``) axis — so a column-parallel weight
+    gets a column-sharded scale, and a row-parallel weight (sharded on
+    ``in``) gets a replicated scale. Row-parallel correctness holds because
+    the scale is constant along the contracted axis: ``psum((x_s @ q_s) *
+    scale) == (Σ x_s @ q_s) * scale`` — the model's existing
+    ``qmatmul``-then-``psum`` needs no changes. Raw leaves pass through."""
+    if not isinstance(leaf, QTensor):
+        return spec
+    parts = tuple(spec)
+    scale_spec = P(*parts[:-2], parts[-1]) if len(parts) >= 2 else P()
+    return type(leaf)(q=spec, scale=scale_spec)
+
+
+def put_maybe_quant(leaf, spec: P, mesh: Mesh, put=None):
+    """device_put a maybe-quantized leaf with quant-aware per-component
+    shardings. ``put`` overrides the placement call (e.g. ``put_global`` for
+    multi-controller runs)."""
+    put = put or jax.device_put
+    if isinstance(leaf, QTensor):
+        sub = quant_leaf_spec(spec, leaf)
+        return type(leaf)(
+            q=put(leaf.q, NamedSharding(mesh, sub.q)),
+            scale=put(leaf.scale, NamedSharding(mesh, sub.scale)),
+        )
+    return put(leaf, NamedSharding(mesh, spec))
+
+
 def qkv_perm_indices(h3: int, tp: int) -> np.ndarray:
     """Column permutation for a fused-qkv last axis [q | k | v] →
     [q_0 k_0 v_0 | q_1 k_1 v_1 | ...] so a contiguous 1/tp slice is a
@@ -112,12 +144,23 @@ def qkv_perm_indices(h3: int, tp: int) -> np.ndarray:
     return np.asarray(idx, np.int32)
 
 
+def _take_cols(w, idx):
+    """Column-permute a maybe-quantized weight (the per-column scale
+    permutes with its columns)."""
+    if isinstance(w, QTensor):
+        return type(w)(
+            q=jnp.take(jnp.asarray(w.q), idx, axis=-1),
+            scale=jnp.take(jnp.asarray(w.scale), idx, axis=-1),
+        )
+    return jnp.take(jnp.asarray(w), idx, axis=-1)
+
+
 def permute_gpt2_tp_layers(layers: dict, tp: int) -> dict:
     """Permute the fused qkv weight + bias for explicit TP; other leaves
     pass through. Device-side gather — works on numpy or jax arrays."""
     idx = qkv_perm_indices(int(layers["b_qkv"].shape[-1]), tp)
     out = dict(layers)
-    out["w_qkv"] = jnp.take(jnp.asarray(layers["w_qkv"]), idx, axis=-1)
+    out["w_qkv"] = _take_cols(layers["w_qkv"], idx)
     out["b_qkv"] = jnp.take(jnp.asarray(layers["b_qkv"]), idx, axis=-1)
     return out
 
@@ -159,27 +202,21 @@ def validate_tp(cfg: ModelConfig, tp: int) -> None:
 def shard_params_tp(cfg: ModelConfig, params: Any, mesh: Mesh) -> Any:
     """device_put params with megatron shardings; GSPMD does the rest
     (llama and gpt2 — no permutation needed here: jit keeps global
-    semantics and XLA reshards the fused qkv split as required)."""
-    from ..ops.quant import QTensor, is_quantized
-
+    semantics and XLA reshards the fused qkv split as required). Quantized
+    leaves get per-component specs via ``quant_leaf_spec`` — int8 and TP
+    compose (≙ the reference quantizing and sharding together,
+    ``/root/reference/utils/model_sharder.py:28-45``)."""
     if cfg.model_type == "llama":
         specs = llama_tp_specs()
     elif cfg.model_type == "gpt2":
         specs = gpt2_tp_specs()
     else:
         raise NotImplementedError(f"TP specs: {cfg.model_type!r} unsupported")
-    if is_quantized(params["layers"]) or any(
-        isinstance(v, QTensor) for k, v in params.items() if k != "layers"
-    ):
-        raise NotImplementedError(
-            "tensor parallelism over int8-quantized weights is not "
-            "supported yet (QTensor leaves need per-component specs)"
-        )
     tp = mesh.shape[TENSOR_AXIS]
     validate_tp(cfg, tp)
 
     def put(path_spec, leaf):
-        return jax.device_put(leaf, NamedSharding(mesh, path_spec))
+        return put_maybe_quant(leaf, path_spec, mesh)
 
     out = {
         k: put(specs[k], v)

@@ -22,11 +22,13 @@ builds the sharded stage arrays. Capabilities preserved:
   the ring-propagated origin-marking trick is unnecessary when one host owns
   all chips (SURVEY.md §7 step 6).
 - **Request-edge privacy** (≙ embedding-before-transport,
-  ``node_worker.py:215-223`` and README privacy note): ``embed_prompt`` lets
-  a caller turn token ids into hidden states host-side; raw ids never need to
-  touch the serving path (``submit_embedding`` is the stage-0 injection
-  point, ≙ ``_forward_request``/``receive_request``,
-  ``node_worker.py:476-491``).
+  ``node_worker.py:215-223`` and README privacy note): ``embed_prompt`` turns
+  token ids into hidden states host-side; ``PipelineServer.submit_embedding``
+  and ``pipeline_generate(..., prompt_embeds=)`` accept those hidden states
+  directly (the stage-0 injection point, ≙ ``_forward_request``/
+  ``receive_request``, ``node_worker.py:476-491``) — raw ids never enter the
+  serving path, and decoding is token-exact vs the ids entry
+  (tests/test_serve.py, tests/test_pipeline.py).
 - **Streaming detokenized output** (≙ the streamed ``tokenizer.decode``
   prints, ``node_worker.py:286-298``): ``generate_text_stream`` yields text
   deltas.
@@ -93,16 +95,11 @@ class PipelineEngine:
         if self.data_parallel < 1 or self.tensor_parallel < 1:
             raise ValueError("data_parallel/tensor_parallel must be >= 1")
         if self.tensor_parallel > 1:
-            from ..ops.quant import is_quantized
             from ..parallel.tensor import validate_tp
 
             validate_tp(cfg, self.tensor_parallel)
-            if is_quantized(self._full_layers):
-                raise NotImplementedError(
-                    "tensor parallelism over int8-quantized weights is not "
-                    "supported yet (QTensor leaves need per-component specs)"
-                )
 
+        self._devices = devices
         if placement is None:
             n = num_stages
             if n is None:
@@ -114,9 +111,31 @@ class PipelineEngine:
                     )
                 n = n_dev // rep
             placement = PlacementSpec.balanced(cfg.num_hidden_layers, n)
-        self.mesh = self._build_mesh(placement.num_stages, devices)
-        self._devices = devices
+        self.mesh = self._build_mesh(
+            self._pipe_size(placement.num_stages), devices
+        )
         self.apply_placement(placement)
+
+    def _pipe_size(self, num_virtual: int) -> int:
+        """Pipe-axis size for a chain of ``num_virtual`` stages. A chain
+        LONGER than the hardware runs k = num_virtual / pipe consecutive
+        stage-slices per device (``PlacementSpec.grouped`` — ≙ the
+        reference's multiple controllers per host, ``send_config.py:36-44``:
+        chain length is decoupled from device count)."""
+        n_dev = len(self._devices if self._devices is not None else jax.devices())
+        cap = n_dev // (self.data_parallel * self.tensor_parallel)
+        if num_virtual <= cap:
+            return num_virtual
+        # largest pipe size that divides the chain — a 12-stage chain on 8
+        # devices runs 2 stages each on 6 of them (2 idle), not an error
+        for pipe in range(cap, 0, -1):
+            if num_virtual % pipe == 0:
+                return pipe
+        raise ValueError(
+            f"a {num_virtual}-stage chain needs at least one pipe device; "
+            f"{cap} available (dp×tp uses "
+            f"{self.data_parallel * self.tensor_parallel} of {n_dev})"
+        )
 
     def _build_mesh(self, num_stages: int, devices):
         if self.data_parallel == 1 and self.tensor_parallel == 1:
@@ -179,10 +198,17 @@ class PipelineEngine:
                 f"placement covers {spec.num_layers} layers but model has "
                 f"{self.cfg.num_hidden_layers}"
             )
-        if spec.num_stages != self.mesh.shape[PIPE_AXIS]:
+        # A chain longer than the pipe axis executes grouped: k consecutive
+        # stages per device, ppermute once per k virtual stages (r3 next-#8).
+        pipe = self._pipe_size(spec.num_stages)
+        exec_spec = (
+            spec if pipe == spec.num_stages
+            else spec.grouped(spec.num_stages // pipe)
+        )
+        if pipe != self.mesh.shape[PIPE_AXIS]:
             # stage-count change needs a new mesh (≙ worker recreation when
             # the role bit flips, node_worker.py:455-466); dp/tp carry over
-            mesh = self._build_mesh(spec.num_stages, self._devices)
+            mesh = self._build_mesh(pipe, self._devices)
         else:
             mesh = self.mesh
 
@@ -191,7 +217,7 @@ class PipelineEngine:
         from ..parallel.distributed import put_global
         from ..parallel.head import VOCAB_SHARDED, shard_head_host
 
-        stage_np, masks_np = stack_stage_params(spec, self._full_layers)
+        stage_np, masks_np = stack_stage_params(exec_spec, self._full_layers)
         pipe_shard = NamedSharding(mesh, P(PIPE_AXIS))  # axis 0 → stages
         repl = NamedSharding(mesh, P())
         # put_global (not device_put): each process materializes only its
@@ -202,12 +228,15 @@ class PipelineEngine:
         # megatron specs the pipeline program uses (no tensor-axis replica in
         # HBM); gpt2 stays pipe-sharded — pipeline_generate column-permutes
         # its fused qkv device-side before the tensor split applies.
+        # int8 QTensor leaves take per-component specs (q like the raw
+        # weight, scale on the output axis) — int8 × TP compose (r3 next-#4).
         if self.tensor_parallel > 1 and self.cfg.model_type == "llama":
             from ..parallel.pipeline import stage_layer_specs
+            from ..parallel.tensor import put_maybe_quant
 
             leaf_specs = stage_layer_specs(self.cfg, self.tensor_parallel)
             stage_layers = {
-                k: put_global(a, NamedSharding(mesh, leaf_specs[k]))
+                k: put_maybe_quant(a, leaf_specs[k], mesh, put=put_global)
                 for k, a in stage_np.items()
             }
         else:
@@ -219,7 +248,7 @@ class PipelineEngine:
         # holds only its V/num_stages slice (≙ the reference's role split —
         # embedding on user-facing nodes, lm_head on the last node,
         # node_worker.py:105-125, 155-164 — done as vocab parallelism).
-        head_np = shard_head_host(self.cfg, self._head_host, spec.num_stages)
+        head_np = shard_head_host(self.cfg, self._head_host, exec_spec.num_stages)
         # tree.map so int8 QTensor tables (q + per-row scale, both stage-
         # stacked on axis 0) take the pipe sharding leaf-by-leaf
         head_params = {
@@ -234,15 +263,16 @@ class PipelineEngine:
         # old (mesh, arrays) tuple or the new one, never a mix.
         with self._lock:
             self.mesh = mesh
-            self.placement = spec
+            self.placement = spec  # the operator's chain (may be virtual)
+            self.exec_placement = exec_spec  # what the devices actually run
             self.stage_layers = stage_layers
             self.layer_masks = masks
             self.head_params = head_params
             # live servers are bound to the old arrays — invalidate
             self._server = None
         logger.info(
-            "placement applied: %d stages, ranges %s",
-            spec.num_stages, list(spec.stages),
+            "placement applied: %d stages over %d pipe devices, ranges %s",
+            spec.num_stages, exec_spec.num_stages, list(spec.stages),
         )
 
     # -- serving ------------------------------------------------------------
@@ -392,6 +422,8 @@ class PipelineEngine:
         *,
         temperature: float = 0.0,
         seed: int = 0,
+        top_k: int = 0,
+        top_p: float = 1.0,
         stop=None,
     ) -> Iterator[str]:
         """Streaming text deltas (≙ node_worker.py:286-298), served from the
@@ -403,7 +435,8 @@ class PipelineEngine:
         ids = np.asarray(tok(prompt)["input_ids"], np.int32)
         srv = self._shared_server(ids.shape[0], max_new_tokens)
         req = srv.submit(
-            ids, max_new_tokens, temperature=temperature, seed=seed, stop=stop
+            ids, max_new_tokens, temperature=temperature, seed=seed,
+            top_k=top_k, top_p=top_p, stop=stop,
         )
         prev = ""
         acc: list[int] = []

@@ -129,3 +129,22 @@ def test_gpt2_pipeline_token_exact():
     spec = PlacementSpec.balanced(cfg.num_hidden_layers, 4)
     res = _run_pipeline(cfg, params, spec, prompt, N)
     np.testing.assert_array_equal(res.tokens, oracle.tokens)
+
+
+def test_prompt_embeds_token_exact(params):
+    """Privacy entry (≙ the reference's request-injection channel,
+    ``/root/reference/utils/node_worker.py:476-491``): decoding from
+    host-side embeddings — ids never entering the program — produces exactly
+    the ids path's tokens. The out buffer's prompt region stays zeros (the
+    ids were never given), so only the generated region is compared."""
+    prompt = np.array([[5, 3, 11, 2, 9, 1]], dtype=np.int32)
+    S = prompt.shape[1]
+    N = 10
+    oracle = generate(CFG, params, prompt, N, cache_dtype=jnp.float32)
+    spec = PlacementSpec.balanced(CFG.num_hidden_layers, 4)
+    h = np.asarray(params["embed"])[prompt]  # [1, S, H] host-side embedding
+    res = _run_pipeline(
+        CFG, params, spec, np.zeros_like(prompt), N, prompt_embeds=h
+    )
+    np.testing.assert_array_equal(res.tokens[:, S:], oracle.tokens[:, S:])
+    np.testing.assert_array_equal(res.lengths, oracle.lengths)

@@ -82,3 +82,64 @@ def test_profile_stage_drives_uneven_split_token_exact():
     )
     oracle = generate(cfg, params, prompt, 8, cache_dtype=jnp.float32)
     np.testing.assert_array_equal(res.tokens, oracle.tokens)
+
+
+def test_grouped_merges_consecutive_stages():
+    spec = PlacementSpec.from_ranges(
+        [(0, 2), (2, 3), (3, 6), (6, 8)], 8
+    )
+    assert spec.grouped(2).stages == ((0, 3), (3, 8))
+    assert spec.grouped(1).stages == spec.stages
+    with pytest.raises(ValueError, match="group"):
+        spec.grouped(3)
+
+
+def test_virtual_chain_longer_than_devices_token_exact():
+    """A 16-stage placement on an 8-device mesh (VERDICT r3 next-#8, ≙ the
+    reference's multiple-controllers-per-host: a 4-stage chain over 3
+    machines, ``/root/reference/send_config.py:36-44``): each device runs 2
+    consecutive stage-slices back to back, ppermute once per 2 virtual
+    stages, token-exact vs the monolith."""
+    from llm_sharding_tpu.runtime.engine import PipelineEngine
+    from llm_sharding_tpu.runtime.generate import generate
+
+    cfg = tiny_llama(num_hidden_layers=16)
+    params = llama.init_params(cfg, jax.random.key(21), dtype=jnp.float32)
+    spec = PlacementSpec.balanced(16, 16)
+    eng = PipelineEngine(
+        cfg, dict(params), placement=spec, cache_dtype=jnp.float32
+    )
+    assert eng.placement.num_stages == 16
+    assert eng.exec_placement.num_stages == len(jax.devices())
+    assert eng.mesh.shape["pipe"] == len(jax.devices())
+
+    prompt = np.array([[5, 3, 11, 2]], np.int32)
+    res = eng.generate_ids(prompt, 8)
+    oracle = generate(cfg, params, prompt, 8, cache_dtype=jnp.float32)
+    np.testing.assert_array_equal(res.tokens, oracle.tokens)
+
+    # hot-apply back to a hardware-sized chain: the same engine serves both
+    eng.apply_placement(PlacementSpec.balanced(16, len(jax.devices())))
+    res_hw = eng.generate_ids(prompt, 8)
+    np.testing.assert_array_equal(res_hw.tokens, oracle.tokens)
+
+
+def test_virtual_chain_non_divisor_uses_largest_divisor():
+    """12 stages on 8 devices: the engine picks the LARGEST pipe size that
+    divides the chain (6 devices × 2 stages each, 2 idle) rather than
+    erroring — chain length stays a placement property, not a hardware one."""
+    cfg = tiny_llama(num_hidden_layers=12)
+    params = llama.init_params(cfg, jax.random.key(22), dtype=jnp.float32)
+    spec = PlacementSpec.balanced(12, 12)
+    from llm_sharding_tpu.runtime.engine import PipelineEngine
+    from llm_sharding_tpu.runtime.generate import generate
+
+    eng = PipelineEngine(
+        cfg, dict(params), placement=spec, cache_dtype=jnp.float32
+    )
+    assert eng.exec_placement.num_stages == 6
+    assert eng.mesh.shape["pipe"] == 6
+    prompt = np.array([[4, 9, 1]], np.int32)
+    res = eng.generate_ids(prompt, 6)
+    oracle = generate(cfg, params, prompt, 6, cache_dtype=jnp.float32)
+    np.testing.assert_array_equal(res.tokens, oracle.tokens)

@@ -136,7 +136,13 @@ def test_quantized_stage_loading_ragged(qsetup, tmp_path):
     assert wq.q.shape[0] == 4 and wq.scale.shape[0] == 4
 
 
-def test_tp_rejects_quantized(qsetup):
+def test_tp_quantized_token_exact(qsetup):
+    """int8 × TP (VERDICT r3 next-#4): QTensor leaves take per-component
+    specs (q sharded like the raw weight, scale on the output axis —
+    ``tensor.quant_leaf_spec``), so a pp×tp mesh decodes the quantized model
+    token-exactly vs the quantized monolith. Row-parallel layers work
+    because the per-out-column scale factors out of the contracted axis:
+    ``psum((x_s @ q_s) * scale) == (Σ x_s @ q_s) * scale``."""
     from llm_sharding_tpu.parallel.distributed import hybrid_mesh
     from llm_sharding_tpu.parallel.pipeline import pipeline_generate
     from llm_sharding_tpu.parallel.placement import (
@@ -149,12 +155,27 @@ def test_tp_rejects_quantized(qsetup):
     spec = PlacementSpec.balanced(cfg.num_hidden_layers, 2)
     sl, masks = stack_stage_params(spec, qparams["layers"])
     head = {k: v for k, v in qparams.items() if k != "layers"}
-    with pytest.raises(NotImplementedError, match="int8-quantized"):
-        pipeline_generate(
-            cfg, mesh, sl, masks, head,
-            np.array([[5, 9, 2, 14]], np.int32), 4,
-            cache_dtype=jnp.float32,
-        )
+    prompt = np.array([[5, 9, 2, 14]], np.int32)
+    res = pipeline_generate(
+        cfg, mesh, sl, masks, head, prompt, 8, cache_dtype=jnp.float32
+    )
+    oracle = generate(cfg, qparams, prompt, 8, cache_dtype=jnp.float32)
+    np.testing.assert_array_equal(res.tokens, oracle.tokens)
+
+
+def test_engine_tp_quantized_token_exact(qsetup):
+    """int8 × TP from the engine: quantized megatron-split weights land
+    pre-sharded (per-component put, ``tensor.put_maybe_quant``) and decode
+    token-exactly vs the quantized monolith."""
+    _, qparams = qsetup
+    eng = PipelineEngine(
+        CFG, dict(qparams), num_stages=2, tensor_parallel=2,
+        cache_dtype=jnp.float32,
+    )
+    prompt = np.array([[3, 8, 13, 2]], np.int32)
+    res = eng.generate_ids(prompt, 8)
+    oracle = generate(CFG, qparams, prompt, 8, cache_dtype=jnp.float32)
+    np.testing.assert_array_equal(res.tokens, oracle.tokens)
 
 
 def test_int4_quantize_round_trip_error_bounded():

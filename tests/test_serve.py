@@ -295,11 +295,13 @@ def test_stop_requires_tokenizer(setup):
         srv.submit(np.array([1, 2], np.int32), 4, stop=[""])
 
 
-def test_cancel_during_chunked_admission_deferred(setup):
-    """cancel() of a row whose slot is mid-chunked-admission must NOT touch
-    the device done flags (serve_admit_finish would overwrite them when it
-    arms the slot) — it defers, and the flag lands after admission finishes
-    (the application lives at the end of _admit_chunked)."""
+def test_cancel_serialized_against_step(setup):
+    """cancel() and step() share the server mutex (ADVICE r3 #4): a cancel
+    from another thread can never interleave with a mid-chunked admission,
+    so the device done flag is always safe to set directly — and a
+    cancel issued while a pump thread holds the lock lands after the step."""
+    import threading
+
     params, eng = setup
     srv = eng.serve(capacity=64, batch_per_slot=1)
     rng = np.random.default_rng(9)
@@ -307,15 +309,60 @@ def test_cancel_during_chunked_admission_deferred(setup):
     ra = srv.submit(pa, 30)
     srv.step()
     row = ra.row
-    srv._admitting_rows.add(row)  # simulate: slot re-entered admission
-    assert srv.cancel(ra) and ra.done
-    assert row in srv._pending_cancels
-    assert not bool(np.asarray(srv.state.done)[row]), (
-        "device done set while the slot was mid-admission"
-    )
-    # what _admit_chunked's tail does once serve_admit_finish ran:
-    srv._admitting_rows.discard(row)
-    srv._cancel_rows([row])
-    srv._pending_cancels.discard(row)
+    t = threading.Thread(target=lambda: srv.cancel(ra))
+    with srv._mutex:  # simulate: pump thread mid-step
+        t.start()
+        t.join(timeout=0.2)
+        assert t.is_alive(), "cancel ran while the pump held the lock"
+    t.join()
+    assert ra.done
     assert bool(np.asarray(srv.state.done)[row])
     srv.run_until_idle()
+
+
+def test_submit_embedding_token_exact(setup):
+    """Privacy entry: ``submit_embedding(embed_prompt(ids))`` decodes exactly
+    the tokens of ``submit(ids)`` — raw ids never enter the serving path
+    (≙ the reference's request-injection channel,
+    ``/root/reference/utils/node_worker.py:476-491``, ``README.md:17``).
+    batch_per_slot=2 forces the admission batching to keep the embeds
+    request out of the ids request's program."""
+    params, eng = setup
+    srv = eng.serve(capacity=64, batch_per_slot=2)
+    rng = np.random.default_rng(7)
+    p = rng.integers(1, CFG.vocab_size, 6).astype(np.int32)
+    ra = srv.submit(p, max_new_tokens=10)
+    rb = srv.submit_embedding(eng.embed_prompt(p)[0], max_new_tokens=10)
+    # a sampled embeds request walks the same per-row key chain
+    rc = srv.submit_embedding(
+        eng.embed_prompt(p)[0], max_new_tokens=10, temperature=0.9, seed=5
+    )
+    srv.run_until_idle()
+    want = oracle_tokens(params, p, 10)
+    assert ra.tokens == want
+    assert rb.tokens == want
+    res = generate(
+        CFG, params, p[None], 10, temperature=0.9, seed=5,
+        cache_dtype=jnp.float32,
+    )
+    want_s = list(res.tokens[0, len(p): int(res.lengths[0])])
+    assert rc.tokens == want_s
+    assert srv.counters.requests_completed == 3
+
+
+def test_submit_embedding_validation(setup):
+    _, eng = setup
+    srv = eng.serve(capacity=64)
+    with pytest.raises(ValueError, match="prompt_embeds must be"):
+        srv.submit_embedding(np.zeros((4, 3), np.float32), 4)
+    with pytest.raises(ValueError, match="one request"):
+        srv.submit_embedding(
+            np.zeros((2, 4, CFG.hidden_size), np.float32), 4
+        )
+    # both entries validate filters identically (_resolve_filters)
+    with pytest.raises(ValueError, match="top_k"):
+        srv.submit_embedding(
+            np.zeros((4, CFG.hidden_size), np.float32), 4, top_k=-3
+        )
+    with pytest.raises(ValueError, match="top_k"):
+        srv.submit(np.array([1, 2], np.int32), 4, top_k=-3)
