@@ -2,43 +2,45 @@
 
 Prints ONE JSON line PER METRIC: {"metric", "value", "unit", "vs_baseline"},
 flushed as produced. The headline metric (3B single-chip greedy decode, the
-round-1/2 metric, unchanged methodology) is emitted FIRST — the full suite
-takes ~25 min on the tunneled chip (serve-program compiles dominate), and the
-anchor must survive a driver-side timeout; it is also repeated as the final
-line for drivers that keep only the last one.
+round-1/2/3 metric, unchanged methodology) is emitted FIRST and repeated
+LAST.
 
-Metrics (VERDICT r2 next-#2, plus int8):
-  a. decode_tok_s_llama2-7b_1chip   — largest 7B-family config on one chip
-     (Llama-2-7B bf16 ~13.5 GB; if it doesn't fit, an explicit error line is
-     emitted — no silent downgrade).
-  b. decode_tok_s_llama2-7b-int8_1chip — the same model with int8-resident
-     weights (≙ the reference's load_in_8bit mode; decode is weight-read
-     bandwidth-bound, so int8 is a direct throughput lever — ops/quant.py).
-     Since r3 the int8 variants quantize the vocab tables too
-     (quantize_head=True: the 3B tied table is 788 MB bf16 — ~20% of
-     per-step weight reads once the layers are int8; measured +9% on chip).
-  c. serve_tok_s_llama3.2-3b_1stage — steady-state continuous-batching
-     throughput: serve_admit + serve_chunk on a 1-stage mesh (the
-     PipelineServer path, previously never timed on hardware).
-  d. pallas_prefill_speedup_s2048   — fused flash-attention kernel vs the XLA
-     score-materializing path at S=C=2048, llama3-8b head geometry, with an
-     on-chip numeric cross-check (bf16).
-  e. decode_tok_s_llama3.2-3b_1chip_c4096 — decode against a 4096-slot KV
-     cache (segmented-decode path; r2 weak #3).
-  f. decode_tok_s_llama3.2-3b-int8_1chip — 3B int8 decode.
-  g. decode_tok_s_llama3.2-3b_1chip — the no-regression anchor metric.
-  h. decode_tok_s_llama3.2-3b_1chip_b8 — aggregated batched decode (8 rows
-     in one program): weight reads amortize across the batch, the
-     single-chip ceiling for DP-style serving.
+Fitting the driver budget (VERDICT r3 next-#2 — r3's run died at rc 124 with
+two metrics uncaptured):
 
-vs_baseline for throughput metrics is tok/s divided by the reference world's
-only number: the ~4 tok/s anecdotal anchor (`/root/reference/start_node.py:20`
-comment; BASELINE.md). For the kernel metric it is the speedup itself (XLA
-path = 1.0).
+- Weights NEVER cross the host boundary: every section inits params directly
+  on device (jax.random) and the serve engine uses the ``host_staging=False``
+  fast path (device-side stage stacking). r3 pulled + re-pushed the full 3B
+  params through the ~tunnel for the serve section — the single largest
+  wall-clock cost.
+- A global wall-clock budget (``BENCH_BUDGET_S``, default 1500 s): each
+  section declares a cost estimate and emits an explicit
+  ``{"skipped_for_time": true}`` line instead of dying mid-suite when the
+  budget would be blown. Skips are visible, never silent.
+- The persistent XLA compile cache is enabled — a warm run (the cache
+  survives across processes) compiles ~nothing.
 
-Weights are random (throughput is weight-value independent); bf16 everywhere.
-On non-TPU hosts every section falls back to a tiny config (smoke mode) and
-metric names change, so CPU lines can never be mistaken for chip numbers.
+Metrics:
+  a. decode_tok_s_llama3.2-3b_1chip — the no-regression ANCHOR (first+last).
+  b. decode_tok_s_llama3.2-3b_1chip_c4096 — decode against a 4096-slot KV.
+  c. decode_tok_s_llama3.2-3b_1chip_b8 — batched decode (8 rows, the
+     single-chip ceiling for DP-style serving).
+  d. serve_tok_s_llama3.2-3b_1stage — steady-state continuous batching
+     (PipelineServer: serve_admit + serve_chunk + host loop).
+  e. decode_tok_s_llama3.2-3b-int8_1chip — int8-resident weights + vocab
+     tables (≙ the reference's load_in_8bit; ops/quant.py).
+  f. decode_tok_s_llama2-7b_1chip — largest 7B-family config on one chip.
+  g. decode_tok_s_llama2-7b-int8_1chip — 7B int8.
+  h. pallas_prefill_speedup_s2048 — fused flash-attention vs the XLA path,
+     S=C=2048, llama3-8b head geometry, with an on-chip numeric cross-check.
+
+vs_baseline for throughput metrics is tok/s over the reference world's only
+number: the ~4 tok/s anecdotal anchor (`/root/reference/start_node.py:20`
+comment; BASELINE.md). For the kernel metric it is the speedup (XLA = 1.0).
+
+Weights are random (throughput is weight-value independent); bf16. On
+non-TPU hosts every section falls back to a tiny config and metric names
+change, so CPU lines can never be mistaken for chip numbers.
 """
 
 import gc
@@ -48,6 +50,14 @@ import sys
 import time
 
 import numpy as np
+
+T0 = time.perf_counter()
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+ANCHOR_TOK_S = 4.0  # BASELINE.md anecdotal anchor
+
+
+def remaining() -> float:
+    return BUDGET_S - (time.perf_counter() - T0)
 
 
 def emit(metric, value, unit, vs_baseline, **extra):
@@ -65,29 +75,15 @@ def emit_error(metric, unit, err):
     emit(metric, 0.0, unit, 0.0, error=str(err)[:300])
 
 
-ANCHOR_TOK_S = 4.0  # BASELINE.md anecdotal anchor
+def emit_skip(metric, unit, est):
+    emit(
+        metric, 0.0, unit, 0.0, skipped_for_time=True,
+        budget_left_s=round(remaining(), 1), section_est_s=est,
+    )
 
 
 def int8_metric_name(name: str) -> str:
     return name.replace("_1chip", "-int8_1chip").replace("_cpu", "-int8_cpu")
-
-
-def bench_int8_variant(name, cfg, params, prompt_len, max_new, generate):
-    """Quantize ``params`` in place (donating, incl. the vocab tables) and
-    emit the int8 decode metric for ``name``. Returns the quantized params
-    (the bf16 input is consumed)."""
-    from llm_sharding_tpu.ops.quant import quantize_params
-
-    n8 = int8_metric_name(name)
-    try:
-        params = quantize_params(params, donate=True, quantize_head=True)
-        tok_s8 = time_decode(
-            cfg, params, prompt_len, max_new, prompt_len + max_new, generate
-        )
-        emit(n8, tok_s8, "tokens/sec", tok_s8 / ANCHOR_TOK_S)
-    except Exception as e:  # noqa: BLE001
-        emit_error(n8, "tokens/sec", e)
-    return params
 
 
 def time_decode(cfg, params, prompt_len, max_new, capacity, generate, batch=1):
@@ -109,34 +105,29 @@ def time_decode(cfg, params, prompt_len, max_new, capacity, generate, batch=1):
     return generated / elapsed
 
 
-def bench_7b(on_tpu, jax, jnp):
-    from llm_sharding_tpu.models import llama
-    from llm_sharding_tpu.models.config import llama2_7b, tiny_llama
-    from llm_sharding_tpu.runtime.generate import generate
+def bench_int8_variant(name, cfg, params, prompt_len, max_new, generate):
+    """Quantize ``params`` in place (donating, incl. the vocab tables) and
+    emit the int8 decode metric for ``name``. Returns the quantized params
+    (the bf16 input is consumed)."""
+    from llm_sharding_tpu.ops.quant import quantize_params
 
-    if on_tpu:
-        name, cfg = "decode_tok_s_llama2-7b_1chip", llama2_7b()
-        prompt_len, max_new = 32, 256
-    else:
-        name, cfg = "decode_tok_s_7b-proxy_cpu", tiny_llama(num_hidden_layers=8)
-        prompt_len, max_new = 8, 16
-    params = llama.init_params(cfg, jax.random.key(0), dtype=jnp.bfloat16)
-    tok_s = time_decode(
-        cfg, params, prompt_len, max_new, prompt_len + max_new, generate
-    )
-    emit(name, tok_s, "tokens/sec", tok_s / ANCHOR_TOK_S)
-
-    # int8-resident weights (donating quantization: peak = params + one leaf)
-    params = bench_int8_variant(name, cfg, params, prompt_len, max_new, generate)
-    del params
-    gc.collect()
+    n8 = int8_metric_name(name)
+    try:
+        params = quantize_params(params, donate=True, quantize_head=True)
+        tok_s8 = time_decode(
+            cfg, params, prompt_len, max_new, prompt_len + max_new, generate
+        )
+        emit(n8, tok_s8, "tokens/sec", tok_s8 / ANCHOR_TOK_S)
+    except Exception as e:  # noqa: BLE001
+        emit_error(n8, "tokens/sec", e)
+    return params
 
 
 def bench_3b(on_tpu, jax, jnp):
-    """3B monolith decode at tight capacity (the anchor metric, methodology
-    identical to rounds 1-2) and at C=4096 (segmented decode, r2 weak #3).
-    Returns host-resident numpy params for the serve bench so the monolithic
-    device copy can be freed before the engine re-device_puts them."""
+    """3B monolith decode: anchor (tight capacity, methodology identical to
+    rounds 1-3), C=4096 segmented decode, batched b8. Returns (cfg, DEVICE
+    params, anchor name, anchor value) — the serve section reuses the device
+    arrays without any host round-trip."""
     from llm_sharding_tpu.models import llama
     from llm_sharding_tpu.models.config import llama32_3b, tiny_llama
     from llm_sharding_tpu.runtime.generate import generate
@@ -174,56 +165,51 @@ def bench_3b(on_tpu, jax, jnp):
     except Exception as e:  # noqa: BLE001 — report, keep benching
         emit_error(names[1], "tokens/sec", e)
 
-    try:
-        tok_s_big = time_decode(cfg, params, prompt_len, max_new, big_c, generate)
-        emit(names[0], tok_s_big, "tokens/sec", tok_s_big / ANCHOR_TOK_S)
-    except Exception as e:  # noqa: BLE001
-        emit_error(names[0], "tokens/sec", e)
+    for name, kwargs, est in (
+        (names[0], dict(capacity=big_c), 90),
+        (names[2], dict(capacity=prompt_len + max_new, batch=b8), 90),
+    ):
+        if remaining() < est + 60:
+            emit_skip(name, "tokens/sec", est)
+            continue
+        try:
+            v = time_decode(
+                cfg, params, prompt_len, max_new,
+                kwargs.get("capacity"), generate,
+                batch=kwargs.get("batch", 1),
+            )
+            emit(name, v, "tokens/sec", v / ANCHOR_TOK_S)
+        except Exception as e:  # noqa: BLE001
+            emit_error(name, "tokens/sec", e)
 
-    try:
-        tok_s_b8 = time_decode(
-            cfg, params, prompt_len, max_new, prompt_len + max_new, generate,
-            batch=b8,
-        )
-        emit(names[2], tok_s_b8, "tokens/sec", tok_s_b8 / ANCHOR_TOK_S)
-    except Exception as e:  # noqa: BLE001
-        emit_error(names[2], "tokens/sec", e)
-
-    try:
-        params_np = jax.tree.map(np.asarray, params)
-    except Exception:  # noqa: BLE001 — serve section will report
-        params_np = None
-    params = bench_int8_variant(
-        names[1], cfg, params, prompt_len, max_new, generate
-    )
-    del params
-    gc.collect()
-    return cfg, params_np, names[1], tok_s
+    return cfg, params, names[1], tok_s
 
 
-def bench_serve(on_tpu, cfg, params_np, jax, jnp):
-    """Steady-state continuous-batching throughput on a 1-stage mesh: the
-    serve_admit + serve_chunk programs (`parallel/serve.py`) driven by the
-    PipelineServer daemon loop (`runtime/server.py`)."""
+def bench_serve(on_tpu, cfg, params, jax, jnp):
+    """Steady-state continuous-batching throughput on a 1-stage mesh. The
+    engine is built with ``host_staging=False``: the device params from
+    bench_3b are stage-stacked ON DEVICE (no host pull/push of 6+ GB
+    through the tunnel — r3's dominant serve-section cost)."""
     from llm_sharding_tpu.runtime.engine import PipelineEngine
 
     name = (
         "serve_tok_s_llama3.2-3b_1stage" if on_tpu else "serve_tok_s_tiny_cpu"
     )
     if on_tpu:
-        # chunk_cycles=16: each step() ends in a host fetch, and on a
-        # tunneled chip that sync is ~100 ms — coarser chunks amortize it
-        # (the serve numbers are otherwise tunnel-RTT noise, 60-85 tok/s).
-        # 8 rows (r3: was 4): decode is weight-read-bound, so rows amortize
-        # the 3.6 GB/step — the b8 monolith metric bounds what's reachable
-        batch_per_slot, capacity, chunk_cycles = 8, 512, 16
+        # 8 rows: decode is weight-read-bound, so rows amortize the per-step
+        # weight reads — the b8 monolith metric bounds what's reachable.
+        # chunk_cycles=8 + pipeline_depth=2: the prefetch thread issues each
+        # chunk's token-log read at dispatch time and the step loop applies
+        # it two chunks later — the tunnel RTT fully overlaps device compute.
+        batch_per_slot, capacity, chunk_cycles, depth = 8, 512, 8, 2
         prompt_len, max_new = 32, 256
     else:
-        batch_per_slot, capacity, chunk_cycles = 2, 64, 2
+        batch_per_slot, capacity, chunk_cycles, depth = 2, 64, 2, 1
         prompt_len, max_new = 8, 16
 
     engine = PipelineEngine(
-        cfg, params_np, num_stages=1, devices=jax.devices()[:1]
+        cfg, params, num_stages=1, devices=jax.devices()[:1],
+        host_staging=False,
     )
     rng = np.random.default_rng(1)
 
@@ -232,6 +218,7 @@ def bench_serve(on_tpu, cfg, params_np, jax, jnp):
             capacity=capacity,
             batch_per_slot=batch_per_slot,
             chunk_cycles=chunk_cycles,
+            pipeline_depth=depth,
         )
         for _ in range(n_requests):
             srv.submit(
@@ -248,6 +235,34 @@ def bench_serve(on_tpu, cfg, params_np, jax, jnp):
     tok_s = srv.counters.tokens_generated / elapsed
     emit(name, tok_s, "tokens/sec", tok_s / ANCHOR_TOK_S, rows=batch_per_slot)
     del engine, srv
+    gc.collect()
+
+
+def bench_7b(on_tpu, jax, jnp):
+    from llm_sharding_tpu.models import llama
+    from llm_sharding_tpu.models.config import llama2_7b, tiny_llama
+    from llm_sharding_tpu.runtime.generate import generate
+
+    if on_tpu:
+        name, cfg = "decode_tok_s_llama2-7b_1chip", llama2_7b()
+        prompt_len, max_new = 32, 192
+    else:
+        name, cfg = "decode_tok_s_7b-proxy_cpu", tiny_llama(num_hidden_layers=8)
+        prompt_len, max_new = 8, 16
+    params = llama.init_params(cfg, jax.random.key(0), dtype=jnp.bfloat16)
+    tok_s = time_decode(
+        cfg, params, prompt_len, max_new, prompt_len + max_new, generate
+    )
+    emit(name, tok_s, "tokens/sec", tok_s / ANCHOR_TOK_S)
+
+    # int8-resident weights (donating quantization: peak = params + one leaf)
+    if remaining() < 150:
+        emit_skip(int8_metric_name(name), "tokens/sec", 150)
+    else:
+        params = bench_int8_variant(
+            name, cfg, params, prompt_len, max_new, generate
+        )
+    del params
     gc.collect()
 
 
@@ -341,7 +356,7 @@ def main():
     npallas = "pallas_prefill_speedup_s2048" if on_tpu else "pallas_prefill_speedup_cpu"
 
     # section order = survival priority under a driver-side timeout:
-    # 3B (anchor emitted immediately) → serve → 7B → pallas
+    # 3B (anchor emitted immediately) → serve → 3B-int8 → pallas → 7B(+int8)
     ret = None
     try:
         ret = bench_3b(on_tpu, jax, jnp)
@@ -350,25 +365,47 @@ def main():
         gc.collect()
 
     if ret is not None and ret[1] is not None:
-        try:
-            bench_serve(on_tpu, ret[0], ret[1], jax, jnp)
-        except Exception as e:  # noqa: BLE001
-            emit_error(nserve, "tokens/sec", e)
-        ret = (ret[0], None, ret[2], ret[3])  # drop the host params copy
+        cfg3b, params3b = ret[0], ret[1]
+        if remaining() < 240:
+            emit_skip(nserve, "tokens/sec", 240)
+        else:
+            try:
+                # the engine aliases the SAME device buffers (no copies) —
+                # params3b must not be donated/freed while it serves
+                bench_serve(on_tpu, cfg3b, params3b, jax, jnp)
+            except Exception as e:  # noqa: BLE001
+                emit_error(nserve, "tokens/sec", e)
+        # int8 AFTER serve: the donating quantization consumes the bf16
+        # buffers the serve engine was aliasing
+        if remaining() < 120:
+            emit_skip(int8_metric_name(n3b), "tokens/sec", 120)
+        else:
+            from llm_sharding_tpu.runtime.generate import generate
+
+            bench_int8_variant(n3b, cfg3b, params3b, 32 if on_tpu else 8,
+                               256 if on_tpu else 16, generate)
+        ret = (ret[0], None, ret[2], ret[3])  # drop the params reference
         gc.collect()
     else:
         emit_error(nserve, "tokens/sec", "not attempted: 3B section failed")
 
-    try:
-        bench_7b(on_tpu, jax, jnp)
-    except Exception as e:  # noqa: BLE001
-        emit_error(n7b, "tokens/sec", e)
-        gc.collect()
+    if remaining() < 90:
+        emit_skip(npallas, "x_speedup_vs_xla", 90)
+    else:
+        try:
+            bench_pallas(on_tpu, jax, jnp)
+        except Exception as e:  # noqa: BLE001
+            emit_error(npallas, "x_speedup_vs_xla", e)
 
-    try:
-        bench_pallas(on_tpu, jax, jnp)
-    except Exception as e:  # noqa: BLE001
-        emit_error(npallas, "x_speedup_vs_xla", e)
+    if remaining() < 240:
+        emit_skip(n7b, "tokens/sec", 240)
+        emit_skip(int8_metric_name(n7b), "tokens/sec", 150)
+    else:
+        try:
+            bench_7b(on_tpu, jax, jnp)
+        except Exception as e:  # noqa: BLE001
+            emit_error(n7b, "tokens/sec", e)
+            gc.collect()
 
     if ret is not None and ret[3] is not None:
         # repeat the anchor LAST too (drivers that keep one line keep this)

@@ -13,11 +13,13 @@ Flow per ``step()``:
 1. admit: pop queued requests into free slots (``serve_admit`` — a prefill
    ring traversal that writes one slot's KV rows on every stage while the
    rest of the pipeline state stays parked);
-2. decode: run one chunk of interleaved microsteps (``serve_chunk``,
+2. decode: dispatch one chunk of interleaved microsteps (``serve_chunk``,
    default one ring cycle = one new token per active slot);
-3. fetch: read the replicated bookkeeping (lengths/done/out) back to host —
-   a few KB — and dispatch new tokens to per-request buffers; finished slots
-   become free for the next admit.
+3. apply: read the PREVIOUS chunk's token log (a few hundred bytes, the
+   only steady-state device read) and replay it into host mirrors of
+   lengths/done — the fetch round-trip overlaps the in-flight chunk's
+   device compute (pipeline depth 1), so the tunnel RTT costs nothing
+   while the server is busy. Finished slots free for the next admit.
 
 Streaming (``stream()``) yields token ids as chunks complete — the sharded
 pipeline IS the streaming path; the full model never lands on one device
@@ -34,6 +36,7 @@ import collections
 import dataclasses
 import itertools
 import logging
+import queue
 import threading
 import time
 from typing import Iterator, Optional, Sequence
@@ -64,6 +67,70 @@ class Counters:
 
     def snapshot(self) -> dict:
         return dataclasses.asdict(self)
+
+
+class _Prefetched:
+    """A device→host read issued eagerly on a background thread. The serving
+    loop dispatches a chunk, hands its token log here, and keeps going; by
+    the time the loop wants the numpy value (one pipeline_depth later) the
+    transfer has already ridden out the chunk's device time + tunnel RTT —
+    the steady-state step loop never blocks on a round trip, and the device
+    queue stays full (measured: the synchronous fetch cost ~36 ms of the
+    ~240 ms serve iteration on the tunneled chip)."""
+
+    __slots__ = ("handle", "value", "error", "event")
+
+    def __init__(self, handle):
+        self.handle = handle
+        self.value = None
+        self.error: Optional[BaseException] = None
+        self.event = threading.Event()
+
+    def get(self) -> np.ndarray:
+        self.event.wait()
+        if self.error is not None:
+            raise self.error
+        return self.value
+
+
+class _Prefetcher:
+    """One PROCESS-WIDE daemon thread fetching queued device arrays FIFO
+    (np.asarray releases the GIL during the transfer). Shared by every
+    server instance — servers are created per placement and discarded on
+    repartition, so a per-server thread would leak one parked thread per
+    rebuild."""
+
+    _instance: Optional["_Prefetcher"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._q: queue.Queue = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="serve-log-prefetch"
+        )
+        self._thread.start()
+
+    @classmethod
+    def shared(cls) -> "_Prefetcher":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def fetch(self, handle) -> _Prefetched:
+        p = _Prefetched(handle)
+        self._q.put(p)
+        return p
+
+    def _run(self) -> None:
+        while True:
+            p = self._q.get()
+            try:
+                p.value = np.asarray(p.handle)
+            except BaseException as e:  # noqa: BLE001 — surfaced via get()
+                p.error = e
+            p.handle = None  # drop the device reference promptly
+            p.event.set()
 
 
 class Request:
@@ -126,6 +193,7 @@ class PipelineServer:
         top_k: int = 0,
         top_p: float = 1.0,
         prefill_chunk: Optional[int] = None,
+        pipeline_depth: int = 1,
     ):
         self.engine = engine
         self.cfg = engine.cfg
@@ -158,6 +226,13 @@ class PipelineServer:
         ):
             raise ValueError("prefill_chunk must be a power of two")
         self.prefill_chunk = prefill_chunk
+        # how many chunk logs may stay in flight: 1 overlaps the fetch with
+        # the next chunk's compute; 2 additionally hides the post-completion
+        # fetch latency (~tunnel one-way) at the cost of tokens surfacing one
+        # more chunk late (throughput mode)
+        if pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
+        self.pipeline_depth = pipeline_depth
         self.counters = Counters()
 
         from ..ops.quant import QTensor
@@ -183,10 +258,21 @@ class PipelineServer:
         M = self.num_stages * batch_per_slot
         self._queue: collections.deque[Request] = collections.deque()
         self._rows: list[Optional[Request]] = [None] * M
-        self._lengths_seen = np.zeros(M, np.int64)
-        # rows mid-chunked-admission: device lengths/done still carry the
-        # previous occupant's values until serve_admit_finish arms the slot,
-        # so interleaved fetches must skip them
+        # HOST MIRRORS of the device bookkeeping, replayed from the per-chunk
+        # token logs (serve_chunk's second output) and per-admit first tokens
+        # — steady-state serving performs exactly ONE small device read per
+        # chunk (the log), applied one chunk late so the ~100 ms tunnel fetch
+        # round-trip overlaps the NEXT chunk's device compute. r3 fetched
+        # lengths+done+out every step: 2-3 round trips per chunk ≈ 60% of
+        # serve wall-clock on the tunneled chip.
+        self._mirror_len = np.zeros(M, np.int64)
+        self._mirror_budget = np.zeros(M, np.int64)
+        self._m = 0  # host mirror of state.m (chunks advance it)
+        self._pending: collections.deque = collections.deque()
+        self._prefetcher = _Prefetcher.shared()
+        self._stop_ids = frozenset(int(t) for t in self.cfg.eos_token_ids)
+        # rows mid-chunked-admission: the slot is parked done on device until
+        # serve_admit_finish arms it; no log entries arrive for it
         self._admitting_rows: set[int] = set()
         self._ids = itertools.count()
         # One lock serializes every public mutation (submit/cancel/step):
@@ -296,11 +382,26 @@ class PipelineServer:
         return req
 
     def step(self) -> bool:
-        """Admit + one decode chunk + fetch. Returns True if work was done."""
+        """Admit + dispatch one decode chunk + apply the previous chunk's
+        token log. Returns True if work was done.
+
+        The log application runs ONE CHUNK BEHIND the dispatch (pipeline
+        depth 1): while the host blocks on fetching chunk n's few-hundred-
+        byte log, the device is already executing chunk n+1 — the tunnel
+        round-trip disappears behind compute. Tokens therefore surface one
+        chunk late; ``run_until_idle`` drains the tail."""
         with self._mutex:
-            progressed = self._admit_pending()
+            progressed = False
+            if self._queue and self._free_slots():
+                # admission needs accurate mirrors → flush outstanding logs
+                # first. Gated on the (possibly stale) mirror view showing a
+                # free slot: under full-slot backlog the flush would block on
+                # the in-flight chunk every step and defeat the pipelining; a
+                # slot freed inside an un-applied log is seen one step later.
+                self._drain(0)
+                progressed |= self._admit_pending()
             if self._any_active():
-                self.state = serve_ops.serve_chunk(
+                self.state, log = serve_ops.serve_chunk(
                     self.cfg,
                     self.mesh,
                     self.engine.stage_layers,
@@ -312,15 +413,21 @@ class PipelineServer:
                     self._sampling,
                     self._filtering,
                 )
+                self._pending.append(
+                    ("chunk", self._prefetcher.fetch(log), self._m)
+                )
+                self._m += self.num_stages * self.chunk_cycles
                 self.counters.chunks += 1
                 progressed = True
-            self._fetch()
+                self._drain(self.pipeline_depth)
+            else:
+                self._drain(0)
             return progressed
 
     def run_until_idle(self) -> None:
         """Drain the queue and all in-flight requests (the test/batch mode;
         a real deployment calls ``step`` from its own loop forever)."""
-        while self._queue or self._any_active():
+        while self._queue or self._any_active() or self._pending:
             self.step()
 
     def cancel(self, req: Request) -> bool:
@@ -365,7 +472,7 @@ class PipelineServer:
         the server. Tokens come one ring cycle at a time from the SHARDED
         program — streaming never materializes the model on one device.
 
-        Reads snapshot under the server mutex: ``_fetch`` extends
+        Reads snapshot under the server mutex: ``_apply_token`` extends
         ``req.tokens`` and (on a stop-sequence hit) truncates them within one
         locked step, so a consumer on another thread observes either the
         pre-extend or the post-truncate state — never tokens past a stop
@@ -533,14 +640,15 @@ class PipelineServer:
                 r.row = slot * Bs + i
                 r.started_at = time.perf_counter()
                 self._rows[r.row] = r
-                self._lengths_seen[r.row] = 0
+                self._mirror_len[r.row] = r.prompt_len
+                self._mirror_budget[r.row] = r.prompt_len + r.max_new
             if not is_emb and self._chunked(bucket):
                 self._admit_chunked(
                     slot, prompts, plen, row_valid, max_new, seeds, temps,
                     topks, topps,
                 )
             else:
-                self.state = serve_ops.serve_admit(
+                self.state, tok0 = serve_ops.serve_admit(
                     self.cfg,
                     self.mesh,
                     self.engine.stage_layers,
@@ -562,6 +670,15 @@ class PipelineServer:
                         None if embeds is None else jnp.asarray(embeds)
                     ),
                     filtering=self._filtering,
+                )
+                # the admission-sampled first token is applied like a chunk
+                # log — deferred, so its fetch also overlaps device compute
+                self._pending.append(
+                    (
+                        "admit",
+                        self._prefetcher.fetch(tok0),
+                        [(r.row, r) for r in batch],
+                    )
                 )
             self.counters.admissions += 1
             admitted = True
@@ -611,7 +728,7 @@ class PipelineServer:
             # admitting rows themselves are in _rows already and must not
             # count, or an idle server would pay a useless cycle per chunk
             if self._any_active(exclude=frozenset(self._admitting_rows)):
-                self.state = serve_ops.serve_chunk(
+                self.state, log = serve_ops.serve_chunk(
                     self.cfg,
                     self.mesh,
                     self.engine.stage_layers,
@@ -623,8 +740,12 @@ class PipelineServer:
                     self._sampling,
                     self._filtering,
                 )
+                self._pending.append(
+                    ("chunk", self._prefetcher.fetch(log), self._m)
+                )
+                self._m += self.num_stages
                 self.counters.chunks += 1
-                self._fetch()
+                self._drain(self.pipeline_depth)
         last_tok = prompts[np.arange(Bs), np.maximum(plen - 1, 0)]
         self.state = serve_ops.serve_admit_finish(
             self.cfg,
@@ -644,39 +765,64 @@ class PipelineServer:
         )
         self._admitting_rows.difference_update(range(row0, row0 + Bs))
 
-    def _fetch(self) -> None:
-        lengths = np.asarray(self.state.lengths)
-        # writable copy: the stop-sequence branch marks rows done locally
-        done = np.array(self.state.done)
-        out = None  # fetched lazily — only when some row progressed
-        for row, req in enumerate(self._rows):
-            if req is None or req.done or row in self._admitting_rows:
-                continue
-            seen = int(self._lengths_seen[row])
-            # first fetch for this row starts after the prompt
-            lo = max(seen, req.prompt_len)
-            hi = int(lengths[row])
-            if hi > lo:
-                if out is None:
-                    out = np.asarray(self.state.out)
-                req.tokens.extend(int(t) for t in out[row, lo:hi])
-                self.counters.tokens_generated += hi - lo
-                if req.stop and self._hit_stop(req):
-                    # stop string surfaced in the decoded text: truncate to
-                    # the minimal token prefix containing it, stop the row
-                    # on device, and run the completion branch below now
-                    # (the local done copy is updated to match)
-                    self._cancel_rows([row])
-                    done[row] = True
-            self._lengths_seen[row] = hi
-            if bool(done[row]):
-                req.done = True
-                req.finished_at = time.perf_counter()
-                self._rows[row] = None  # slot row becomes reusable
-                self.counters.requests_completed += 1
-                dur = req.finished_at - (req.started_at or req.finished_at)
-                ntok = len(req.tokens)
-                logger.info(
-                    "complete id=%d tokens=%d duration=%.3fs tok/s=%.1f",
-                    req.id, ntok, dur, ntok / dur if dur > 0 else float("inf"),
-                )
+    def _drain(self, max_pending: int) -> None:
+        """Apply queued device reads until at most ``max_pending`` remain.
+        ``max_pending=1`` is the steady-state pipeline depth (the newest
+        chunk's log stays in flight while its chunk executes);
+        ``max_pending=0`` is a full flush (before admission decisions and at
+        drain time)."""
+        while len(self._pending) > max_pending:
+            entry = self._pending.popleft()
+            if entry[0] == "chunk":
+                self._apply_log(entry[1].get(), entry[2])
+            else:  # "admit": per-row first tokens from serve_admit
+                tok0 = entry[1].get()
+                for i, (row, req) in enumerate(entry[2]):
+                    if req.done or self._rows[row] is not req:
+                        continue  # cancelled between dispatch and drain
+                    self._apply_token(row, req, int(tok0[i]))
+
+    def _apply_log(self, log: np.ndarray, m0: int) -> None:
+        """Replay one chunk's token log into the host mirrors. At microstep
+        ``m`` the completing slot is ``(m - (S-1)) mod S`` — the host knows
+        ``m`` (it mirrors ``state.m``), so each log row maps to its slot
+        without any device read."""
+        S, Bs = self.num_stages, self.batch_per_slot
+        last = S - 1
+        for i in range(log.shape[0]):
+            row0 = ((m0 + i - last) % S) * Bs
+            for b in range(Bs):
+                t = int(log[i, b])
+                if t < 0:
+                    continue
+                row = row0 + b
+                req = self._rows[row]
+                if req is None or req.done:
+                    continue  # cancelled after this chunk was dispatched
+                self._apply_token(row, req, t)
+
+    def _apply_token(self, row: int, req: Request, t: int) -> None:
+        """One committed token → request buffer + mirrors + completion."""
+        req.tokens.append(t)
+        self.counters.tokens_generated += 1
+        self._mirror_len[row] += 1
+        finished = (
+            t in self._stop_ids
+            or self._mirror_len[row] >= self._mirror_budget[row]
+        )
+        if req.stop and self._hit_stop(req):
+            # stop string surfaced in the decoded text: truncate to the
+            # minimal token prefix containing it and stop the row on device
+            self._cancel_rows([row])
+            finished = True
+        if finished:
+            req.done = True
+            req.finished_at = time.perf_counter()
+            self._rows[row] = None  # slot row becomes reusable
+            self.counters.requests_completed += 1
+            dur = req.finished_at - (req.started_at or req.finished_at)
+            ntok = len(req.tokens)
+            logger.info(
+                "complete id=%d tokens=%d duration=%.3fs tok/s=%.1f",
+                req.id, ntok, dur, ntok / dur if dur > 0 else float("inf"),
+            )

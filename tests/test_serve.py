@@ -366,3 +366,21 @@ def test_submit_embedding_validation(setup):
         )
     with pytest.raises(ValueError, match="top_k"):
         srv.submit(np.array([1, 2], np.int32), 4, top_k=-3)
+
+
+def test_cancel_before_deferred_admit_token_applies(setup):
+    """A request cancelled after its admission was dispatched but before the
+    deferred first-token entry drains must NOT receive a phantom token or be
+    double-counted (the admit branch of _drain guards like _apply_log)."""
+    params, eng = setup
+    srv = eng.serve(capacity=64, pipeline_depth=2)
+    rng = np.random.default_rng(11)
+    p = rng.integers(1, CFG.vocab_size, 4).astype(np.int32)
+    rq = srv.submit(p, 10)
+    srv.step()  # dispatches serve_admit; tok0 entry stays pending (depth 2)
+    assert srv._pending, "admit entry should be deferred"
+    assert srv.cancel(rq)
+    srv.run_until_idle()
+    assert rq.tokens == [], "phantom token applied after cancel"
+    c = srv.counters
+    assert c.requests_cancelled == 1 and c.requests_completed == 0
