@@ -25,6 +25,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import sys
 
 import numpy as np
@@ -228,20 +229,49 @@ def cmd_serve(args) -> int:
     completion per line (≙ the reference's forever-spinning worker loop).
     Lines starting with ``:`` are operator control commands — see
     ``_serve_control`` (hot repartition without restarting the daemon)."""
-    eng = _engine(args)
-    srv = eng.serve(
-        capacity=args.capacity,
-        batch_per_slot=args.batch_per_slot,
-        prefill_chunk=args.prefill_chunk,
-        top_k=args.top_k,
-        top_p=args.top_p,
-    )
-    print(
-        f"serving {eng.cfg.model_type} over {eng.mesh.shape} "
-        f"(capacity={args.capacity}); enter a prompt, ^D to exit; "
-        f":placement <ranges|N> re-shards live",
-        file=sys.stderr,
-    )
+    if getattr(args, "data_parallel", 1) > 1:
+        # data-parallel daemon: D replica servers over disjoint device
+        # groups behind a router (runtime/replicated.py). :placement is a
+        # single-engine control — not offered here.
+        from .runtime.replicated import ReplicatedServer
+        from .utils import shard_store
+
+        cfg, params = shard_store.load_full(args.shards, dtype=_dtype(args.dtype))
+        placement = _placement(args, cfg.num_hidden_layers)
+        srv = ReplicatedServer(
+            cfg, params,
+            data_parallel=args.data_parallel,
+            num_stages=None if placement else getattr(args, "stages", None),
+            placement=placement,
+            tokenizer=shard_store.load_tokenizer(args.shards),
+            capacity=args.capacity,
+            batch_per_slot=args.batch_per_slot,
+            prefill_chunk=args.prefill_chunk,
+            top_k=args.top_k,
+            top_p=args.top_p,
+        )
+        eng = srv.engines[0]
+        print(
+            f"serving {eng.cfg.model_type}: {args.data_parallel} replicas x "
+            f"{eng.mesh.shape} (capacity={args.capacity}); enter a prompt, "
+            "^D to exit",
+            file=sys.stderr,
+        )
+    else:
+        eng = _engine(args)
+        srv = eng.serve(
+            capacity=args.capacity,
+            batch_per_slot=args.batch_per_slot,
+            prefill_chunk=args.prefill_chunk,
+            top_k=args.top_k,
+            top_p=args.top_p,
+        )
+        print(
+            f"serving {eng.cfg.model_type} over {eng.mesh.shape} "
+            f"(capacity={args.capacity}); enter a prompt, ^D to exit; "
+            f":placement <ranges|N> re-shards live",
+            file=sys.stderr,
+        )
     tok = eng._require_tokenizer()
     n_prompt = 0
     for line in sys.stdin:
@@ -249,7 +279,11 @@ def cmd_serve(args) -> int:
         if not prompt:
             continue
         if prompt.startswith(":"):
-            srv = _serve_control(eng, srv, prompt, args)
+            if getattr(args, "data_parallel", 1) > 1:
+                print("control lines are single-engine only (dp daemon)",
+                      file=sys.stderr)
+            else:
+                srv = _serve_control(eng, srv, prompt, args)
             continue
         ids = np.asarray(tok(prompt)["input_ids"], np.int32)
         # per-request seed advances from --seed so two identical sampled
@@ -554,6 +588,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--ranges")
     s.add_argument("--capacity", type=int, default=1024)
     s.add_argument("--batch-per-slot", type=int, default=1, dest="batch_per_slot")
+    s.add_argument(
+        "--data-parallel", type=int, default=1, dest="data_parallel",
+        help="serve N independent pipeline replicas over disjoint device "
+        "groups behind a least-loaded router (runtime/replicated.py)",
+    )
     s.add_argument(
         "--prefill-chunk", type=int, default=None, dest="prefill_chunk",
         help="prefill prompts longer than this in bounded chunks so live "

@@ -39,14 +39,34 @@ def scan_layers(
         cache.pos, positions.astype(jnp.int32), (0, cache.length)
     )
 
+    # The cache rides the scan CARRY with per-layer in-place writes of ONLY
+    # the S new positions — not as stacked scan outputs. Output-stacking
+    # (r1-r3) rewrote every layer's FULL [B, C, ...] row per step: at
+    # decode S=1 that is C× the bytes actually produced (e.g. 0.5 GB/step
+    # of dead writes for an 8-row C=512 serving cache). XLA keeps the
+    # carried buffers in place (dynamic-index read + dynamic-update-slice
+    # write on a loop carry is the standard aliasing pattern).
     def body(carry, xs):
-        h = carry
-        p, k_row, v_row, valid = xs
+        h, k_all, v_all = carry
+        p, l, valid = xs
+        k_row = jax.lax.dynamic_index_in_dim(k_all, l, keepdims=False)
+        v_row = jax.lax.dynamic_index_in_dim(v_all, l, keepdims=False)
         h_new, k_new, v_new = apply_layer(p, h, k_row, v_row, kv_pos, cache.length)
         h = jnp.where(valid, h_new, h)
-        k_row = jnp.where(valid, k_new, k_row)
-        v_row = jnp.where(valid, v_new, v_row)
-        return h, (k_row, v_row)
+        # the layer only changed positions [length, length+S) of its row
+        start = (0, cache.length, 0, 0)
+        new_k = jax.lax.dynamic_slice(k_new, start, (k_new.shape[0], S, *k_new.shape[2:]))
+        new_v = jax.lax.dynamic_slice(v_new, start, (v_new.shape[0], S, *v_new.shape[2:]))
+        old_k = jax.lax.dynamic_slice(k_row, start, new_k.shape)
+        old_v = jax.lax.dynamic_slice(v_row, start, new_v.shape)
+        new_k = jnp.where(valid, new_k, old_k)
+        new_v = jnp.where(valid, new_v, old_v)
+        k_all = jax.lax.dynamic_update_slice(k_all, new_k[None], (l, *start))
+        v_all = jax.lax.dynamic_update_slice(v_all, new_v[None], (l, *start))
+        return (h, k_all, v_all), None
 
-    h, (k_all, v_all) = jax.lax.scan(body, h, (layers, cache.k, cache.v, layer_mask))
+    (h, k_all, v_all), _ = jax.lax.scan(
+        body, (h, cache.k, cache.v),
+        (layers, jnp.arange(L, dtype=jnp.int32), layer_mask),
+    )
     return h, KVCache(k=k_all, v=v_all, pos=kv_pos, length=cache.length + S)

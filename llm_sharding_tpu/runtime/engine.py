@@ -182,14 +182,7 @@ class PipelineEngine:
         """Load from a shard store (≙ NodeController startup: receive config
         → load_shards, ``node_worker.py:403-421``)."""
         cfg, params = shard_store.load_full(shards_dir, dtype=dtype)
-        tokenizer = None
-        if any(f.startswith("tokenizer") for f in os.listdir(shards_dir)):
-            try:
-                from transformers import AutoTokenizer
-
-                tokenizer = AutoTokenizer.from_pretrained(shards_dir)
-            except Exception:
-                tokenizer = None
+        tokenizer = shard_store.load_tokenizer(shards_dir)
         return cls(
             cfg,
             params,
@@ -544,8 +537,12 @@ class PipelineEngine:
     def _require_pipe_only(self, what: str) -> None:
         if self.data_parallel > 1 or self.tensor_parallel > 1:
             raise NotImplementedError(
-                f"{what} runs on the pipe-only engine; hybrid dp/tp engines "
-                "support generate_ids (the shard_map pipeline program)"
+                f"{what} runs on a pipe-only (or pipe×tp via "
+                "ReplicatedServer) engine; in-program dp/tp hybrid engines "
+                "support generate_ids (the shard_map pipeline program). For "
+                "data-parallel continuous batching use "
+                "runtime.replicated.ReplicatedServer — D replica servers "
+                "over disjoint device groups behind a router."
             )
 
     def _require_tokenizer(self):

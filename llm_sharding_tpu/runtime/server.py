@@ -140,6 +140,7 @@ class Request:
         "id", "prompt", "prompt_len", "max_new", "tokens", "done", "row",
         "temperature", "seed", "top_k", "top_p", "stop", "stop_checked",
         "embeds", "submitted_at", "started_at", "finished_at",
+        "__weakref__",  # the dp router tracks request→replica ownership
     )
 
     def __init__(
@@ -453,6 +454,10 @@ class PipelineServer:
                 req.finished_at = time.perf_counter()
                 self.counters.requests_cancelled += 1
                 return True
+            if self._rows[req.row] is not req:
+                # not this server's request (dp router broadcast) or the row
+                # was already freed — touching it would kill another request
+                return False
             self._cancel_rows([req.row])
             req.done = True
             req.finished_at = time.perf_counter()

@@ -290,6 +290,21 @@ def load_config(shards_dir: str) -> ModelConfig:
         return ModelConfig.from_json(f.read())
 
 
+def load_tokenizer(shards_dir: str):
+    """Load the HF tokenizer copied into a shard store, or None if the store
+    carries no tokenizer files (or transformers can't load them). The ONE
+    tokenizer-discovery rule shared by every engine/daemon construction
+    path."""
+    if not any(f.startswith("tokenizer") for f in os.listdir(shards_dir)):
+        return None
+    try:
+        from transformers import AutoTokenizer
+
+        return AutoTokenizer.from_pretrained(shards_dir)
+    except Exception:  # noqa: BLE001 — tokenizer is an optional extra
+        return None
+
+
 def load_stage(
     shards_dir: str,
     start: int,

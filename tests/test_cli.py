@@ -359,3 +359,26 @@ def test_serve_command_stop_flag(shards, capsys, monkeypatch):
     )
     assert rc == 0
     assert '"requests_completed": 1' in capsys.readouterr().err
+
+
+def test_serve_command_data_parallel(shards, capsys, monkeypatch):
+    """dp daemon: two replica servers over device groups, prompts served."""
+    from llm_sharding_tpu.runtime import engine as engine_mod
+
+    monkeypatch.setattr(
+        engine_mod.PipelineEngine,
+        "_require_tokenizer",
+        lambda self: IdTokenizer(),
+    )
+    monkeypatch.setattr("sys.stdin", io.StringIO("hi there\nsecond one\n"))
+    rc = cli.main(
+        [
+            "serve", shards, "--max-new", "4", "--stages", "2",
+            "--data-parallel", "2", "--capacity", "64", "--dtype", "f32",
+        ]
+    )
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert len([l for l in captured.out.splitlines() if l.strip()]) == 2
+    assert '"requests_completed": 2' in captured.err
+    assert "2 replicas" in captured.err
