@@ -269,7 +269,7 @@ class PipelineEngine:
                 self.stage_layers = stage_layers
                 self.layer_masks = masks
                 self.head_params = head_params
-                self._server = None
+                self._servers = {}
             logger.info(
                 "placement applied (device-resident, 1 stage): %s",
                 list(spec.stages),
@@ -326,7 +326,7 @@ class PipelineEngine:
             self.layer_masks = masks
             self.head_params = head_params
             # live servers are bound to the old arrays — invalidate
-            self._server = None
+            self._servers = {}
         logger.info(
             "placement applied: %d stages over %d pipe devices, ranges %s",
             spec.num_stages, exec_spec.num_stages, list(spec.stages),
@@ -452,6 +452,12 @@ class PipelineEngine:
         )
 
     def _shared_server(self, prompt_len: int, max_new: int):
+        """A capacity LADDER of coexisting shared servers (r3 weak #6): a
+        request needing a bigger bucket gets a NEW server alongside the old
+        one instead of draining it — in-flight streams on smaller servers
+        keep producing (each stream pumps its own server). States are
+        per-capacity and geometric, so worst-case HBM for the ladder is
+        ~2× the largest state; ``apply_placement`` frees them all."""
         from .server import ADMIT_BUCKETS
 
         if prompt_len > ADMIT_BUCKETS[-1]:
@@ -461,18 +467,27 @@ class PipelineEngine:
             )
         bucket = next(b for b in ADMIT_BUCKETS if b >= prompt_len)
         needed = bucket + max_new
-        srv = getattr(self, "_server", None)
-        if srv is None or srv.capacity < needed:
-            if srv is not None:
-                # let streams on the old server finish before replacing it —
-                # swapping immediately would orphan their in-flight requests
-                srv.run_until_idle()
-            cap = 64
-            while cap < needed:
-                cap *= 2
-            srv = self.serve(capacity=cap)
-            self._server = srv
-        return srv
+        with self._lock:
+            srvs_ref = self._servers
+        for cap in sorted(srvs_ref):
+            if cap >= needed:
+                return srvs_ref[cap]
+        cap = 64
+        while cap < needed:
+            cap *= 2
+        srv = self.serve(capacity=cap)  # compile outside the lock
+        with self._lock:
+            if self._servers is srvs_ref:
+                # a concurrent first request may have won the build race —
+                # use the registered one so only one state exists per cap
+                existing = self._servers.get(cap)
+                if existing is not None:
+                    return existing
+                self._servers[cap] = srv
+                return srv
+        # apply_placement invalidated the ladder while we were building:
+        # this server reads the OLD arrays — drop it and rebuild on the new
+        return self._shared_server(prompt_len, max_new)
 
     def generate_text_stream(
         self,

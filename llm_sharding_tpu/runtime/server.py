@@ -50,7 +50,12 @@ from ..parallel.mesh import PIPE_AXIS
 
 logger = logging.getLogger("llm_sharding_tpu.server")
 
-ADMIT_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048)
+# Admission prompt buckets: each one a compiled serve_admit shape (compiles
+# happen only for buckets actually used; the ladder tops out at 32k so long-
+# context prompts stream through the shared server too — r3 weak #6's cap)
+ADMIT_BUCKETS = (
+    8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384, 32768,
+)
 
 
 @dataclasses.dataclass

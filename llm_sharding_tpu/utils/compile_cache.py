@@ -33,6 +33,11 @@ def enable_persistent_cache(path: Optional[str] = None) -> Optional[str]:
     path = path or os.environ.get("LLM_SHARDING_TPU_CACHE", _DEFAULT)
     if path.lower() in ("", "0", "off", "none"):
         return None
+    # NOTE: deliberately no backend/platform probe here — this runs before
+    # jax.distributed.initialize in the worker path, and any jax.devices()
+    # call would initialize the XLA backend too early. Callers that know
+    # they are on CPU (where XLA:CPU AOT artifacts are machine-pinned and
+    # reload as portability-error noise) simply skip calling this.
     try:
         os.makedirs(path, exist_ok=True)
     except OSError:
