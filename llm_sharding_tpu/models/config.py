@@ -88,6 +88,18 @@ class ModelConfig:
     def from_hf_config(cls, hf: dict[str, Any]) -> "ModelConfig":
         """Build from a HuggingFace ``config.json`` dict (llama or gpt2)."""
         mt = hf.get("model_type", "llama")
+        if mt == "qwen2":
+            # Qwen2/2.5 is the llama block structure with q/k/v projection
+            # biases (HF's Qwen2Attention hard-codes qkv bias on, o bias
+            # off — the converter emits bq/bk/bv and the block adds them by
+            # key presence). Sliding-window variants are out of scope.
+            if hf.get("use_sliding_window", False):
+                raise ValueError(
+                    "qwen2 sliding-window attention is not supported; "
+                    "convert a checkpoint with use_sliding_window=false"
+                )
+            hf = dict(hf, model_type="llama", attention_bias=True)
+            mt = "llama"
         if mt in ("llama",):
             rs = None
             raw_rs = hf.get("rope_scaling")
@@ -223,6 +235,43 @@ def llama2_70b() -> ModelConfig:
 
 def gpt2_small() -> ModelConfig:
     return ModelConfig.from_hf_config({"model_type": "gpt2"})
+
+
+def qwen25_7b() -> ModelConfig:
+    """Qwen2.5-7B: llama block structure + qkv biases (third model family)."""
+    return ModelConfig.from_hf_config({
+        "model_type": "qwen2",
+        "vocab_size": 152064,
+        "hidden_size": 3584,
+        "intermediate_size": 18944,
+        "num_hidden_layers": 28,
+        "num_attention_heads": 28,
+        "num_key_value_heads": 4,
+        "max_position_embeddings": 32768,
+        "rms_norm_eps": 1e-6,
+        "rope_theta": 1000000.0,
+        "tie_word_embeddings": False,
+        "bos_token_id": 151643,
+        # both the Instruct eos (<|im_end|> 151645) and the base/endoftext id
+        # (151643): the stop set must catch either, whichever weights load
+        "eos_token_id": [151645, 151643],
+    })
+
+
+def tiny_qwen2(**kw) -> ModelConfig:
+    """Tiny qwen2-layout config (llama + qkv biases) for CPU tests."""
+    base = dict(
+        model_type="qwen2",
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+    )
+    base.update(kw)
+    return ModelConfig.from_hf_config(base)
 
 
 def tiny_llama(**kw) -> ModelConfig:

@@ -59,7 +59,7 @@ def init_layer_params(
             fan_in**-0.5, dtype
         )
 
-    return {
+    p = {
         "input_norm": jnp.ones((L, H), dtype),
         "wq": w(ks[0], H, Nh * D),
         "wk": w(ks[1], H, Nkv * D),
@@ -70,6 +70,14 @@ def init_layer_params(
         "w_up": w(ks[5], H, I),
         "w_down": w(ks[6], I, H),
     }
+    if cfg.attention_bias:
+        # qkv biases (the Qwen2-family layout: q/k/v biased, o not); presence
+        # of the keys — not the flag — drives the forward path, so converted
+        # checkpoints control exactly which projections carry bias
+        p["bq"] = jnp.zeros((L, Nh * D), dtype)
+        p["bk"] = jnp.zeros((L, Nkv * D), dtype)
+        p["bv"] = jnp.zeros((L, Nkv * D), dtype)
+    return p
 
 
 def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
@@ -128,14 +136,26 @@ def attn_mlp_block(
     Nkv = out_dim(p["wk"]) // D
 
     x = rms_norm(h, p["input_norm"], cfg.rms_norm_eps)
-    q = apply_rope(qmatmul(x, p["wq"]).reshape(B, S, Nh, D), cos, sin)
-    k = apply_rope(qmatmul(x, p["wk"]).reshape(B, S, Nkv, D), cos, sin)
-    v = qmatmul(x, p["wv"]).reshape(B, S, Nkv, D)
+    # Optional projection biases, keyed by PRESENCE (the Qwen2-family layout
+    # biases q/k/v only — ``bq``/``bk``/``bv`` from the converter; column-
+    # parallel under TP so each shard adds its slice before rope/attention)
+    qx, kx, vx = qmatmul(x, p["wq"]), qmatmul(x, p["wk"]), qmatmul(x, p["wv"])
+    if "bq" in p:
+        qx = qx + p["bq"]
+    if "bk" in p:
+        kx = kx + p["bk"]
+    if "bv" in p:
+        vx = vx + p["bv"]
+    q = apply_rope(qx.reshape(B, S, Nh, D), cos, sin)
+    k = apply_rope(kx.reshape(B, S, Nkv, D), cos, sin)
+    v = vx.reshape(B, S, Nkv, D)
 
     attn = attn_fn(q, k, v)
     attn_out = qmatmul(attn.reshape(B, S, Nh * D), p["wo"])
     if tp_axis is not None:
         attn_out = jax.lax.psum(attn_out, tp_axis)
+    if "bo" in p:  # row-parallel bias: added ONCE, after the psum
+        attn_out = attn_out + p["bo"]
     h = h + attn_out
 
     x = rms_norm(h, p["post_norm"], cfg.rms_norm_eps)

@@ -121,12 +121,16 @@ def stage_layer_specs(cfg: ModelConfig, tp: int, stage_layers: Any = None):
         raise NotImplementedError(f"pp×tp: {cfg.model_type!r} unsupported")
     from .tensor import quant_leaf_spec
 
+    # restrict to the keys actually present (optional bias keys exist only
+    # for checkpoints that carry them); with stage_layers=None (the engine's
+    # per-key lookup path) return the full table
+    keys = per_leaf if stage_layers is None else stage_layers
     return {
         k: quant_leaf_spec(
-            P(PIPE_AXIS, None, *s),
+            P(PIPE_AXIS, None, *per_leaf[k]),
             None if stage_layers is None else stage_layers.get(k),
         )
-        for k, s in per_leaf.items()
+        for k in keys
     }
 
 

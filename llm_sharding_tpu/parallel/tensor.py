@@ -43,6 +43,7 @@ def llama_tp_specs(stacked: bool = True) -> dict[str, P]:
     L = (None,) if stacked else ()
     col = P(*L, None, TENSOR_AXIS)  # [L, in, out] sharded on out
     row = P(*L, TENSOR_AXIS, None)  # [L, in, out] sharded on in
+    col_b = P(*L, TENSOR_AXIS)  # column-parallel bias: shards with its cols
     rep = P()
     return {
         "layers": {
@@ -55,6 +56,12 @@ def llama_tp_specs(stacked: bool = True) -> dict[str, P]:
             "w_gate": col,
             "w_up": col,
             "w_down": row,
+            # optional bias keys (qwen2-family / biased-llama checkpoints);
+            # consumers look up by the keys actually present
+            "bq": col_b,
+            "bk": col_b,
+            "bv": col_b,
+            "bo": rep,  # row-parallel output bias: added once, post-psum
         },
         "embed": rep,
         "final_norm": rep,

@@ -36,18 +36,23 @@ def _getter(src: Mapping[str, np.ndarray] | TensorGetter) -> TensorGetter:
 def llama_layer_arrays(
     cfg: ModelConfig, get: TensorGetter, i: int, dtype
 ) -> dict[str, jnp.ndarray]:
-    """One decoder layer's params (un-stacked), ≙ ``block_{i}.pth``."""
-    if cfg.attention_bias or cfg.mlp_bias:
+    """One decoder layer's params (un-stacked), ≙ ``block_{i}.pth``.
+
+    ``attention_bias`` checkpoints (the Qwen2 family: q/k/v biased, o not)
+    emit ``bq``/``bk``/``bv`` — the block adds biases by key presence, so
+    exactly the projections the checkpoint biases carry them. ``mlp_bias``
+    has no target family yet and is still refused rather than dropped."""
+    if cfg.mlp_bias:
         raise ValueError(
-            "attention_bias/mlp_bias checkpoints are not wired through yet; "
-            "refusing to silently drop bias tensors"
+            "mlp_bias checkpoints are not wired through yet; refusing to "
+            "silently drop bias tensors"
         )
     pre = f"model.layers.{i}."
 
     def lin(name):  # torch Linear stores [out, in]; we use [in, out]
         return jnp.asarray(get(pre + name + ".weight").T, dtype)
 
-    return {
+    p = {
         "input_norm": jnp.asarray(get(pre + "input_layernorm.weight"), dtype),
         "wq": lin("self_attn.q_proj"),
         "wk": lin("self_attn.k_proj"),
@@ -58,6 +63,17 @@ def llama_layer_arrays(
         "w_up": lin("mlp.up_proj"),
         "w_down": lin("mlp.down_proj"),
     }
+    if cfg.attention_bias:
+        for key, name in (
+            ("bq", "self_attn.q_proj"),
+            ("bk", "self_attn.k_proj"),
+            ("bv", "self_attn.v_proj"),
+            ("bo", "self_attn.o_proj"),  # llama attention_bias biases o too;
+            # qwen2 does not ship one — probed, not assumed
+        ):
+            if _has(get, pre + name + ".bias"):
+                p[key] = jnp.asarray(get(pre + name + ".bias"), dtype)
+    return p
 
 
 def gpt2_layer_arrays(

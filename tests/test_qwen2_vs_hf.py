@@ -1,0 +1,119 @@
+"""Golden test: the qwen2 family (llama block + q/k/v projection biases) ==
+HF transformers (torch CPU) on tiny configs — the third model family beyond
+the reference's llama/gpt2 pair (``/root/reference/utils/model_sharder.py:
+64,96``), proving the converter + block are architecture-parameterized, and
+that the biased layers flow through the pipeline + serve + TP paths
+token-exactly."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import torch
+from transformers import Qwen2Config, Qwen2ForCausalLM
+
+from llm_sharding_tpu.models import llama
+from llm_sharding_tpu.models.cache import init_cache
+from llm_sharding_tpu.models.config import tiny_qwen2
+from llm_sharding_tpu.runtime.engine import PipelineEngine
+from llm_sharding_tpu.runtime.generate import generate
+from llm_sharding_tpu.utils.convert import params_from_hf
+
+CFG = tiny_qwen2()
+
+
+@pytest.fixture(scope="module")
+def hf_model():
+    torch.manual_seed(3)
+    hf_cfg = Qwen2Config(
+        vocab_size=CFG.vocab_size,
+        hidden_size=CFG.hidden_size,
+        intermediate_size=CFG.intermediate_size,
+        num_hidden_layers=CFG.num_hidden_layers,
+        num_attention_heads=CFG.num_attention_heads,
+        num_key_value_heads=CFG.num_key_value_heads,
+        max_position_embeddings=CFG.max_position_embeddings,
+        rms_norm_eps=CFG.rms_norm_eps,
+        rope_theta=CFG.rope_theta,
+        tie_word_embeddings=False,
+        use_sliding_window=False,
+    )
+    model = Qwen2ForCausalLM(hf_cfg)
+    model.eval()
+    return model
+
+
+@pytest.fixture(scope="module")
+def params(hf_model):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    return params_from_hf(CFG, sd, dtype=jnp.float32)
+
+
+def test_config_maps_qwen2_to_biased_llama():
+    assert CFG.model_type == "llama" and CFG.attention_bias
+    from llm_sharding_tpu.models.config import ModelConfig
+
+    with pytest.raises(ValueError, match="sliding"):
+        ModelConfig.from_hf_config(
+            {"model_type": "qwen2", "use_sliding_window": True,
+             "vocab_size": 8, "hidden_size": 8, "intermediate_size": 8,
+             "num_hidden_layers": 1, "num_attention_heads": 1}
+        )
+
+
+def test_converter_emits_qkv_biases(params):
+    lyr = params["layers"]
+    assert "bq" in lyr and "bk" in lyr and "bv" in lyr
+    assert "bo" not in lyr  # qwen2 ships no o_proj bias
+
+
+def test_full_sequence_logits_match(hf_model, params):
+    B, S = 2, 12
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, CFG.vocab_size, (B, S)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_model(torch.from_numpy(ids)).logits.numpy()
+
+    cache = init_cache(CFG, B, capacity=S, dtype=jnp.float32)
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    logits, _ = llama.forward(CFG, params, jnp.asarray(ids), cache, positions)
+    np.testing.assert_allclose(np.asarray(logits), ref, atol=2e-4, rtol=2e-3)
+
+
+def test_pipeline_and_tp_serve_qwen2_token_exact(params):
+    """The biased layers ride every parallel path: 4-stage pipeline serve and
+    pp2×tp2 generate, token-exact vs the monolith."""
+    eng = PipelineEngine(CFG, dict(params), num_stages=4, cache_dtype=jnp.float32)
+    rng = np.random.default_rng(4)
+    p = rng.integers(1, CFG.vocab_size, 6).astype(np.int32)
+    oracle = generate(CFG, params, p[None], 10, cache_dtype=jnp.float32)
+    want = [int(x) for x in oracle.tokens[0, 6: int(oracle.lengths[0])]]
+
+    srv = eng.serve(capacity=64)
+    req = srv.submit(p, 10)
+    srv.run_until_idle()
+    assert req.tokens == want
+
+    tp_eng = PipelineEngine(
+        CFG, dict(params), num_stages=2, tensor_parallel=2,
+        cache_dtype=jnp.float32,
+    )
+    res = tp_eng.generate_ids(p[None], 10)
+    np.testing.assert_array_equal(res.tokens, oracle.tokens)
+
+
+def test_qwen2_store_round_trip(hf_model, params, tmp_path):
+    """convert → shard store → load_full: the biased blocks round-trip
+    (generic per-key npz blocks; nothing hardcodes the llama key set)."""
+    from llm_sharding_tpu.utils import shard_store
+
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    out = str(tmp_path / "qwen_store")
+    shard_store.save_shards_streaming(CFG, sd, out, dtype=jnp.float32)
+    cfg2, loaded = shard_store.load_full(out, dtype=jnp.float32)
+    assert cfg2.attention_bias and "bq" in loaded["layers"]
+    p = np.array([[5, 9, 2, 14]], np.int32)
+    a = generate(CFG, params, p, 8, cache_dtype=jnp.float32)
+    b = generate(cfg2, loaded, p, 8, cache_dtype=jnp.float32)
+    np.testing.assert_array_equal(a.tokens, b.tokens)
