@@ -159,6 +159,65 @@ def make_state(
     return state
 
 
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "mesh", "num_stages", "cache_dtype")
+)
+def prefix_prefill(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    stage_layers: Any,
+    layer_masks: jnp.ndarray,
+    head_params: Any,  # vocab-sharded
+    prefix: jnp.ndarray,      # [1, Sp] right-padded prefix ids
+    prefix_len: jnp.ndarray,  # scalar int32
+    num_stages: int,
+    cache_dtype,
+):
+    """Prefill a SHARED PREFIX once, returning its per-stage KV — the device
+    side of prefix caching. Requests admitted with this handle skip the
+    prefix's prefill entirely (``serve_admit(prefix_kv=...)`` seeds the
+    slot's cache rows from it): an N-request batch over a shared system
+    prompt pays the prompt's FLOPs once instead of N times. Returns
+    ``(k [S, Lp, 1, Sp, Nkv, Dh], v, pos [S, 1, Sp])`` — pipe-sharded, like
+    a 1-row slice of the serve state's cache."""
+    fns = model_fns(cfg)
+    Sp = prefix.shape[1]
+    ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+    def body(stage_layers, layer_mask, head_params, prefix, prefix_len):
+        layers = jax.tree.map(lambda a: a[0], stage_layers)
+        lmask = layer_mask[0]
+        hd = local_view(head_params)
+        sidx = jax.lax.axis_index(PIPE_AXIS)
+        Lp = lmask.shape[0]
+        cache = KVCache(
+            k=jnp.zeros((Lp, 1, Sp, cfg.num_key_value_heads, cfg.head_dim_), cache_dtype),
+            v=jnp.zeros((Lp, 1, Sp, cfg.num_key_value_heads, cfg.head_dim_), cache_dtype),
+            pos=jnp.full((1, Sp), POS_SENTINEL, jnp.int32),
+            length=jnp.zeros((), jnp.int32),
+        )
+        idx = jnp.arange(Sp, dtype=jnp.int32)
+        positions = jnp.where(
+            idx[None, :] < prefix_len, idx[None, :], POS_SENTINEL
+        )
+        h = sp_embed(cfg, hd, prefix, positions)
+        _, cache = ring_chain(
+            fns, cfg, layers, lmask, sidx, ring, num_stages, h, cache,
+            positions,
+        )
+        return cache.k[None], cache.v[None], cache.pos[None]
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(PIPE_AXIS), P(PIPE_AXIS), head_specs(head_params), P(), P(),
+        ),
+        out_specs=(P(PIPE_AXIS), P(PIPE_AXIS), P(PIPE_AXIS)),
+        check_vma=False,
+    )(stage_layers, layer_masks, head_params, prefix, prefix_len)
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
 def serve_cancel_rows(state: ServeState, rows_mask: jnp.ndarray) -> ServeState:
     """Mark rows done from the host between chunks (request cancellation and
@@ -193,6 +252,8 @@ def serve_admit(
     cache_dtype,
     prompt_embeds: Any = None,  # [Bs, Sp, H]: privacy entry — ids never enter
     filtering: bool = True,  # static: compile top-k/top-p machinery
+    prefix_kv: Any = None,  # (k, v, pos) from prefix_prefill — prefix caching
+    prefix_len: Any = None,  # scalar int32 real prefix length
 ):
     """Prefill ``slot`` with up to Bs new requests while the rest of the
     pipeline state is parked. Returns the updated state.
@@ -206,7 +267,12 @@ def serve_admit(
     lookup and enters the ring with caller-provided hidden states (≙ the
     reference's request-injection channel, ``node_worker.py:476-491`` — raw
     text/ids never leave the node that accepted the request); ``prompts``
-    then only fills the replicated out buffer — pass zeros."""
+    then only fills the replicated out buffer — pass zeros.
+
+    With ``prefix_kv`` (a ``prefix_prefill`` result) the slot's cache rows
+    are SEEDED with the shared prefix's keys/values — ``prompts`` carries
+    only each request's suffix, at absolute positions ``prefix_len + i``,
+    and the prefix's prefill compute is never repeated (prefix caching)."""
     fns = model_fns(cfg)
     Bs, Sp = prompts.shape
     ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
@@ -214,7 +280,7 @@ def serve_admit(
 
     def body(stage_layers, layer_mask, head_params, state, prompts,
              prompt_len, row_valid, slot, max_new, seeds, temperature,
-             top_k, top_p, prompt_embeds):
+             top_k, top_p, prompt_embeds, prefix_kv, prefix_len):
         layers = jax.tree.map(lambda a: a[0], stage_layers)
         lmask = layer_mask[0]
         hd = local_view(head_params)
@@ -235,8 +301,32 @@ def serve_admit(
             length=jnp.zeros((), jnp.int32),
         )
         idx = jnp.arange(Sp, dtype=jnp.int32)
+        if prefix_kv is None:
+            pfx = jnp.zeros((), jnp.int32)  # no prefix: positions from 0
+        else:
+            pfx = prefix_len
+            pk, pv, ppos = prefix_kv  # [1, Lp, 1, Spx, Nkv, Dh] local views
+            pk, pv, ppos = pk[0], pv[0], ppos[0]
+            Spx = pk.shape[2]
+            # broadcast the 1-row prefix over the slot's Bs rows; the suffix
+            # prefill writes AFTER the (bucket-padded) prefix region
+            kb = jnp.broadcast_to(
+                pk, (Lp, Bs, Spx, *pk.shape[3:])
+            ).astype(cache_dtype)
+            vb = jnp.broadcast_to(
+                pv, (Lp, Bs, Spx, *pv.shape[3:])
+            ).astype(cache_dtype)
+            posb = jnp.broadcast_to(ppos, (Bs, Spx))
+            cache = KVCache(
+                k=jax.lax.dynamic_update_slice(cache.k, kb, (0, 0, 0, 0, 0)),
+                v=jax.lax.dynamic_update_slice(cache.v, vb, (0, 0, 0, 0, 0)),
+                pos=jax.lax.dynamic_update_slice(cache.pos, posb, (0, 0)),
+                length=jnp.asarray(Spx, jnp.int32),
+            )
         positions = jnp.where(
-            idx[None, :] < prompt_len[:, None], idx[None, :], POS_SENTINEL
+            idx[None, :] < prompt_len[:, None],
+            pfx + idx[None, :],
+            POS_SENTINEL,
         )
         if prompt_embeds is None:
             h = sp_embed(cfg, hd, prompts, positions)
@@ -260,15 +350,19 @@ def serve_admit(
         tok0 = jnp.where(row_valid, tok0, 0)
 
         # ---- scatter the slot into the parked state ----
+        # total sequence length per row (prefix + suffix; pfx is 0 without
+        # a prefix handle) drives every length-indexed bookkeeping field
+        total = pfx + prompt_len
+        off0 = 0 if prefix_kv is None else int(prefix_kv[0].shape[3])
         k_new = jax.lax.dynamic_update_slice_in_dim(st.k, cache.k, row0, axis=1)
         v_new = jax.lax.dynamic_update_slice_in_dim(st.v, cache.v, row0, axis=1)
         kpos_new = jax.lax.dynamic_update_slice_in_dim(
             st.kpos, cache.pos, row0, axis=0
         )
         pos_slots = jax.lax.dynamic_update_slice_in_dim(
-            st.pos_slots, prompt_len, row0, axis=0
+            st.pos_slots, total, row0, axis=0
         )
-        write_off = st.write_off.at[slot].set(Sp)
+        write_off = st.write_off.at[slot].set(off0 + Sp)
 
         rows = row0 + jnp.arange(Bs, dtype=jnp.int32)
         out_rows = jnp.zeros((Bs, C), jnp.int32)
@@ -277,16 +371,16 @@ def serve_admit(
         out = jax.lax.dynamic_update_slice_in_dim(st.out, out_rows, row0, axis=0)
 
         lengths = jax.lax.dynamic_update_slice_in_dim(
-            st.lengths, jnp.where(row_valid, prompt_len + 1, 0), row0, axis=0
+            st.lengths, jnp.where(row_valid, total + 1, 0), row0, axis=0
         )
         budget = jax.lax.dynamic_update_slice_in_dim(
-            st.budget, jnp.where(row_valid, prompt_len + max_new, 0), row0,
+            st.budget, jnp.where(row_valid, total + max_new, 0), row0,
             axis=0,
         )
         done0 = _is_stop(cfg, tok0) | ~row_valid | (max_new <= 1)
         done = jax.lax.dynamic_update_slice_in_dim(st.done, done0, row0, axis=0)
 
-        inj = sp_embed(cfg, hd, tok0[:, None], prompt_len[:, None])  # [Bs,1,H]
+        inj = sp_embed(cfg, hd, tok0[:, None], total[:, None])  # [Bs,1,H]
         inject = jax.lax.dynamic_update_slice_in_dim(
             st.inject, inj.astype(st.inject.dtype), row0, axis=0
         )

@@ -121,3 +121,34 @@ def test_13b_v5e16_memory_budget():
 def test_unknown_device_kind_fails_loudly():
     with pytest.raises(ValueError, match="unknown TPU device kind"):
         hbm_bytes_for_device_kind("GPU H100")
+
+
+def test_70b_shape_32_virtual_stages_on_8_devices():
+    """The 70B/v5p-32 rung's CHAIN SHAPE on 8 devices: a 32-stage placement
+    runs 4 consecutive stage-slices per device (PlacementSpec.grouped — the
+    engine's virtual-chain path), token-exact vs the monolith. Combined with
+    test_32_stage_interleaved (32 real virtual devices) and the memory
+    budget below, this pins every piece of the ladder's top rung that can be
+    proven without 32 chips."""
+    import numpy as np
+    import jax
+    from llm_sharding_tpu.models import llama
+    from llm_sharding_tpu.models.config import tiny_llama
+    from llm_sharding_tpu.runtime.engine import PipelineEngine
+    from llm_sharding_tpu.runtime.generate import generate
+
+    cfg = tiny_llama(
+        num_hidden_layers=32, vocab_size=64, hidden_size=32,
+        intermediate_size=64, num_attention_heads=2, num_key_value_heads=2,
+    )
+    params = llama.init_params(cfg, jax.random.key(2), dtype=jnp.float32)
+    eng = PipelineEngine(
+        cfg, dict(params), placement=PlacementSpec.balanced(32, 32),
+        cache_dtype=jnp.float32,
+    )
+    assert eng.placement.num_stages == 32
+    assert eng.exec_placement.num_stages == len(jax.devices())
+    prompt = np.asarray([[5, 9, 2, 7]], np.int32)
+    res = eng.generate_ids(prompt, 6)
+    oracle = generate(cfg, params, prompt, 6, cache_dtype=jnp.float32)
+    np.testing.assert_array_equal(res.tokens, oracle.tokens)
