@@ -427,12 +427,16 @@ def serve_admit(
             P(PIPE_AXIS), P(PIPE_AXIS), head_specs(head_params), specs,
             P(), P(), P(), P(), P(), P(), P(), P(), P(),
             P(),  # no-op when prompt_embeds is None (leafless pytree)
+            # prefix_kv is pipe-sharded like the serve cache ([S, Lp, ...]);
+            # both are leafless no-ops when prefix caching is off
+            P(PIPE_AXIS),
+            P(),
         ),
         out_specs=(specs, P()),
         check_vma=False,
     )(stage_layers, layer_masks, head_params, state, prompts, prompt_len,
       row_valid, slot, max_new, seeds, temperature, top_k, top_p,
-      prompt_embeds)
+      prompt_embeds, prefix_kv, prefix_len)
     return out_state, tok0
 
 
