@@ -33,6 +33,11 @@ Metrics:
   g. decode_tok_s_llama2-7b-int8_1chip — 7B int8.
   h. pallas_prefill_speedup_s2048 — fused flash-attention vs the XLA path,
      S=C=2048, llama3-8b head geometry, with an on-chip numeric cross-check.
+  i. hop_latency_p50_us_1chip_loopback — p50 per-hop ppermute latency of a
+     decode-shaped block (BASELINE north-star secondary; loopback on 1 chip).
+  j. prefix_cache_speedup_p1008 — N serve requests over one shared 1008-token
+     system prompt: prefill_prefix handle vs full-prompt admission, greedy
+     tokens cross-checked equal.
 
 vs_baseline for throughput metrics is tok/s over the reference world's only
 number: the ~4 tok/s anecdotal anchor (`/root/reference/start_node.py:20`
@@ -242,8 +247,104 @@ def bench_serve(on_tpu, cfg, params, jax, jnp):
         elapsed = time.perf_counter() - t0
         tok_s = max(tok_s, srv.counters.tokens_generated / elapsed)
     emit(name, tok_s, "tokens/sec", tok_s / ANCHOR_TOK_S, rows=batch_per_slot)
-    del engine, srv
+    del srv
     gc.collect()
+    return engine
+
+
+def bench_prefix_cache(on_tpu, engine):
+    """Prefix caching at the serve level: N requests sharing one long system
+    prompt, admitted with a ``prefill_prefix`` handle vs as full prompts.
+    Lengths are chosen so BOTH paths admit at exact buckets (no padding
+    artifact): full = 1008+16 = 1024 → bucket 1024; prefix path = bucket-1024
+    prefix + bucket-16 suffixes. Greedy tokens are cross-checked equal, so
+    the speedup is measured on verified-identical output."""
+    name = "prefix_cache_speedup_p1008" if on_tpu else "prefix_cache_speedup_cpu"
+    if on_tpu:
+        pfx_len, sfx_len, max_new, nreq, capacity = 1008, 16, 32, 8, 2048
+    else:
+        pfx_len, sfx_len, max_new, nreq, capacity = 56, 8, 8, 2, 128
+    cfg = engine.cfg
+    rng = np.random.default_rng(2)
+    prefix = rng.integers(0, cfg.vocab_size, pfx_len).astype(np.int32)
+    sfx = [
+        rng.integers(0, cfg.vocab_size, sfx_len).astype(np.int32)
+        for _ in range(nreq)
+    ]
+    full = [np.concatenate([prefix, s]) for s in sfx]
+
+    # ONE server — and so one ServeState allocation — reused by both paths
+    # and every rep: a fresh per-rep server piles up multi-GB KV states
+    # faster than the async runtime frees them (measured: ResourceExhausted
+    # on chip with 3B + 6 states in flight)
+    srv = engine.serve(
+        capacity=capacity, batch_per_slot=nreq, chunk_cycles=4,
+        pipeline_depth=2,
+    )
+
+    def run_full():
+        reqs = [srv.submit(p, max_new_tokens=max_new) for p in full]
+        srv.run_until_idle()
+        return [r.tokens for r in reqs]
+
+    def run_prefixed():
+        t_pfx0 = time.perf_counter()
+        h = srv.prefill_prefix(prefix)
+        t_pfx = time.perf_counter() - t_pfx0
+        reqs = [srv.submit(s, max_new_tokens=max_new, prefix=h) for s in sfx]
+        srv.run_until_idle()
+        return [r.tokens for r in reqs], t_pfx
+
+    toks_full = run_full()  # compile full-bucket admit + chunk
+    toks_pfx, t_pfx = run_prefixed()  # compile prefix programs
+    if toks_full != toks_pfx:
+        raise AssertionError("prefix-cached tokens diverge from full-prompt")
+
+    t_full = t_prefix = float("inf")
+    for _ in range(2):  # best-of-2 (tunnel jitter)
+        t0 = time.perf_counter()
+        run_full()
+        t_full = min(t_full, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run_prefixed()
+        t_prefix = min(t_prefix, time.perf_counter() - t0)
+    del srv
+    gc.collect()
+    # the handle build is INSIDE t_prefix — the speedup holds even when the
+    # prefix prefill is not amortized over multiple batches
+    emit(
+        name, t_full / t_prefix, "x_speedup_vs_full_prefill",
+        t_full / t_prefix, full_s=round(t_full, 3),
+        prefixed_s=round(t_prefix, 3), prefix_prefill_s=round(t_pfx, 3),
+        prefix_len=pfx_len, requests=nreq,
+    )
+
+
+def bench_hop_latency(on_tpu, jax, jnp):
+    """p50 inter-stage hidden-state hop latency — BASELINE.md's north-star
+    secondary metric. One chip → the ppermute is a LOOPBACK (self-edge) and
+    the metric is labeled as such; the reference's per-hop wire is
+    torch.save → disk → ZMQ → disk → torch.load (`node_worker.py:44-67`),
+    ≥ 1 ms — vs_baseline reports the measured hop against that 1 ms floor."""
+    from llm_sharding_tpu.parallel.mesh import pipeline_mesh
+    from llm_sharding_tpu.profiler.profiler import measure_hop_latency
+
+    n = len(jax.devices())
+    name = (
+        "hop_latency_p50_us_1chip_loopback" if on_tpu
+        else f"hop_latency_p50_us_cpu_ring{n}"
+    )
+    mesh = pipeline_mesh(num_stages=n)
+    hidden = 3072 if on_tpu else 64  # 3B decode-block geometry on chip
+    rep = measure_hop_latency(mesh, hidden_size=hidden, repeats=10)
+    # p50 can clamp to 0.0 if jitter swamps the hop delta — never divide by
+    # it raw (an error line here would drop the north-star metric entirely)
+    emit(
+        name, rep.p50_us, "us", 1000.0 / max(rep.p50_us, 0.01),
+        p99_us=round(rep.p99_us, 2), bytes_per_hop=rep.bytes_per_hop,
+        loopback=n == 1,
+        note="vs_baseline = 1ms reference wire-hop floor / measured",
+    )
 
 
 def bench_7b(on_tpu, jax, jnp):
@@ -365,6 +466,11 @@ def main():
     n3b = "decode_tok_s_llama3.2-3b_1chip" if on_tpu else "decode_tok_s_tiny_cpu"
     nserve = "serve_tok_s_llama3.2-3b_1stage" if on_tpu else "serve_tok_s_tiny_cpu"
     npallas = "pallas_prefill_speedup_s2048" if on_tpu else "pallas_prefill_speedup_cpu"
+    nprefix = "prefix_cache_speedup_p1008" if on_tpu else "prefix_cache_speedup_cpu"
+    nhop = (
+        "hop_latency_p50_us_1chip_loopback" if on_tpu
+        else f"hop_latency_p50_us_cpu_ring{len(jax.devices())}"
+    )
 
     # section order = survival priority under a driver-side timeout:
     # 3B (anchor emitted immediately) → serve → 3B-int8 → pallas → 7B(+int8)
@@ -377,15 +483,37 @@ def main():
 
     if ret is not None and ret[1] is not None:
         cfg3b, params3b = ret[0], ret[1]
+        serve_engine = None
         if remaining() < 240:
             emit_skip(nserve, "tokens/sec", 240)
         else:
             try:
                 # the engine aliases the SAME device buffers (no copies) —
                 # params3b must not be donated/freed while it serves
-                bench_serve(on_tpu, cfg3b, params3b, jax, jnp)
+                serve_engine = bench_serve(on_tpu, cfg3b, params3b, jax, jnp)
             except Exception as e:  # noqa: BLE001
                 emit_error(nserve, "tokens/sec", e)
+        # hop latency before the heavier sections: the north-star secondary
+        # metric is cheap and must survive a driver timeout
+        if remaining() < 60:
+            emit_skip(nhop, "us", 60)
+        else:
+            try:
+                bench_hop_latency(on_tpu, jax, jnp)
+            except Exception as e:  # noqa: BLE001
+                emit_error(nhop, "us", e)
+        if serve_engine is None:
+            emit_error(nprefix, "x_speedup_vs_full_prefill",
+                       "not attempted: serve engine unavailable")
+        elif remaining() < 180:
+            emit_skip(nprefix, "x_speedup_vs_full_prefill", 180)
+        else:
+            try:
+                bench_prefix_cache(on_tpu, serve_engine)
+            except Exception as e:  # noqa: BLE001
+                emit_error(nprefix, "x_speedup_vs_full_prefill", e)
+        del serve_engine
+        gc.collect()
         # int8 AFTER serve: the donating quantization consumes the bf16
         # buffers the serve engine was aliasing
         if remaining() < 120:
@@ -399,6 +527,9 @@ def main():
         gc.collect()
     else:
         emit_error(nserve, "tokens/sec", "not attempted: 3B section failed")
+        emit_error(nhop, "us", "not attempted: 3B section failed")
+        emit_error(nprefix, "x_speedup_vs_full_prefill",
+                   "not attempted: 3B section failed")
 
     if remaining() < 90:
         emit_skip(npallas, "x_speedup_vs_xla", 90)

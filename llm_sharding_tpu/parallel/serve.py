@@ -48,7 +48,8 @@ from .head import (
     sp_embed, sp_next_token, sp_sample_rows,
 )
 from .mesh import PIPE_AXIS
-from .pipeline import model_fns, ring_chain
+from .pipeline import model_fns, ring_chain, stage_layer_specs
+from .tensor import TENSOR_AXIS
 
 
 class ServeState(NamedTuple):
@@ -78,11 +79,31 @@ class ServeState(NamedTuple):
     m: jax.Array          # scalar int32 microstep counter
 
 
-def state_specs(state: ServeState) -> ServeState:
+def _dev(spec: P) -> bool:
+    """True for per-device (pipe-stacked) leaves — the bodies strip/restore
+    their leading stage dim. A prefix match, not equality: with tensor
+    parallelism the KV leaves carry a TENSOR_AXIS entry on the heads dim."""
+    return len(spec) > 0 and spec[0] == PIPE_AXIS
+
+
+def _kv_spec(tp: int) -> P:
+    """Spec of every serve-side KV array ([S, Lp, rows, C, Nkv, Dh] state
+    leaves and the [S, Lp, 1, Spx, Nkv, Dh] prefix handle): tp > 1 megatron-
+    shards the heads dim (the stage fn computes only its tensor shard's
+    heads — the caches store exactly those). THE single source of the KV
+    layout; state_specs, make_state and prefix_prefill all read it."""
+    return (
+        P(PIPE_AXIS) if tp == 1
+        else P(PIPE_AXIS, None, None, None, TENSOR_AXIS)
+    )
+
+
+def state_specs(state: ServeState, tp: int = 1) -> ServeState:
     dev = P(PIPE_AXIS)
     rep = P()
+    kv = _kv_spec(tp)
     return ServeState(
-        k=dev, v=dev, kpos=dev, h=dev, h_valid=dev, pos_slots=dev,
+        k=kv, v=kv, kpos=dev, h=dev, h_valid=dev, pos_slots=dev,
         write_off=dev, out=rep, lengths=rep, done=rep, budget=rep,
         inject=rep, inject_pending=rep, rng=rep, temp=rep, topk=rep,
         topp=rep, m=rep,
@@ -98,6 +119,7 @@ def make_state(
     batch_per_slot: int = 1,
     cache_dtype=jnp.bfloat16,
     act_dtype=jnp.bfloat16,
+    tp: int = 1,
 ) -> ServeState:
     """Host-constructed empty state (all slots free / done)."""
     S = mesh.shape[PIPE_AXIS]
@@ -108,6 +130,7 @@ def make_state(
     H = cfg.hidden_size
     dev = NamedSharding(mesh, P(PIPE_AXIS))
     rep = NamedSharding(mesh, P())
+    dev_kv = NamedSharding(mesh, _kv_spec(tp))
 
     single = jax.process_count() == 1
 
@@ -137,8 +160,8 @@ def make_state(
 
     kv_shape = (S, Lp, M, C, cfg.num_key_value_heads, cfg.head_dim_)
     state = ServeState(
-        k=zeros(kv_shape, cache_dtype, dev),
-        v=zeros(kv_shape, cache_dtype, dev),
+        k=zeros(kv_shape, cache_dtype, dev_kv),
+        v=zeros(kv_shape, cache_dtype, dev_kv),
         kpos=put(np.full((S, M, C), int(POS_SENTINEL), np.int32), dev),
         h=put(np.zeros((S, Bs, 1, H), act_dtype), dev),
         h_valid=put(np.zeros((S,), np.bool_), dev),
@@ -160,7 +183,7 @@ def make_state(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "mesh", "num_stages", "cache_dtype")
+    jax.jit, static_argnames=("cfg", "mesh", "num_stages", "cache_dtype", "tp")
 )
 def prefix_prefill(
     cfg: ModelConfig,
@@ -172,6 +195,7 @@ def prefix_prefill(
     prefix_len: jnp.ndarray,  # scalar int32
     num_stages: int,
     cache_dtype,
+    tp: int = 1,
 ):
     """Prefill a SHARED PREFIX once, returning its per-stage KV — the device
     side of prefix caching. Requests admitted with this handle skip the
@@ -180,8 +204,9 @@ def prefix_prefill(
     prompt pays the prompt's FLOPs once instead of N times. Returns
     ``(k [S, Lp, 1, Sp, Nkv, Dh], v, pos [S, 1, Sp])`` — pipe-sharded, like
     a 1-row slice of the serve state's cache."""
-    fns = model_fns(cfg)
+    fns = model_fns(cfg, tp_axis=TENSOR_AXIS if tp > 1 else None)
     Sp = prefix.shape[1]
+    nkv = cfg.num_key_value_heads // tp  # heads LOCAL to a tensor shard
     ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
 
     def body(stage_layers, layer_mask, head_params, prefix, prefix_len):
@@ -191,8 +216,8 @@ def prefix_prefill(
         sidx = jax.lax.axis_index(PIPE_AXIS)
         Lp = lmask.shape[0]
         cache = KVCache(
-            k=jnp.zeros((Lp, 1, Sp, cfg.num_key_value_heads, cfg.head_dim_), cache_dtype),
-            v=jnp.zeros((Lp, 1, Sp, cfg.num_key_value_heads, cfg.head_dim_), cache_dtype),
+            k=jnp.zeros((Lp, 1, Sp, nkv, cfg.head_dim_), cache_dtype),
+            v=jnp.zeros((Lp, 1, Sp, nkv, cfg.head_dim_), cache_dtype),
             pos=jnp.full((1, Sp), POS_SENTINEL, jnp.int32),
             length=jnp.zeros((), jnp.int32),
         )
@@ -207,13 +232,15 @@ def prefix_prefill(
         )
         return cache.k[None], cache.v[None], cache.pos[None]
 
+    kv_spec = _kv_spec(tp)
     return jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(
-            P(PIPE_AXIS), P(PIPE_AXIS), head_specs(head_params), P(), P(),
+            stage_layer_specs(cfg, tp, stage_layers), P(PIPE_AXIS),
+            head_specs(head_params), P(), P(),
         ),
-        out_specs=(P(PIPE_AXIS), P(PIPE_AXIS), P(PIPE_AXIS)),
+        out_specs=(kv_spec, kv_spec, P(PIPE_AXIS)),
         check_vma=False,
     )(stage_layers, layer_masks, head_params, prefix, prefix_len)
 
@@ -230,7 +257,9 @@ def serve_cancel_rows(state: ServeState, rows_mask: jnp.ndarray) -> ServeState:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "mesh", "num_stages", "cache_dtype", "filtering"),
+    static_argnames=(
+        "cfg", "mesh", "num_stages", "cache_dtype", "filtering", "tp",
+    ),
 )
 def serve_admit(
     cfg: ModelConfig,
@@ -254,6 +283,7 @@ def serve_admit(
     filtering: bool = True,  # static: compile top-k/top-p machinery
     prefix_kv: Any = None,  # (k, v, pos) from prefix_prefill — prefix caching
     prefix_len: Any = None,  # scalar int32 real prefix length
+    tp: int = 1,  # static: tensor-parallel degree (megatron-sharded heads)
 ):
     """Prefill ``slot`` with up to Bs new requests while the rest of the
     pipeline state is parked. Returns the updated state.
@@ -273,8 +303,9 @@ def serve_admit(
     are SEEDED with the shared prefix's keys/values — ``prompts`` carries
     only each request's suffix, at absolute positions ``prefix_len + i``,
     and the prefix's prefill compute is never repeated (prefix caching)."""
-    fns = model_fns(cfg)
+    fns = model_fns(cfg, tp_axis=TENSOR_AXIS if tp > 1 else None)
     Bs, Sp = prompts.shape
+    nkv = cfg.num_key_value_heads // tp  # heads LOCAL to a tensor shard
     ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
     C = state.out.shape[1]
 
@@ -286,14 +317,14 @@ def serve_admit(
         hd = local_view(head_params)
         sidx = jax.lax.axis_index(PIPE_AXIS)
         st = jax.tree.map(
-            lambda spec, leaf: leaf[0] if spec == P(PIPE_AXIS) else leaf,
-            state_specs(state), state,
+            lambda spec, leaf: leaf[0] if _dev(spec) else leaf,
+            state_specs(state, tp), state,
         )
         row0 = slot * Bs
 
         # fresh cache rows for this slot only
         Lp = lmask.shape[0]
-        kv_shape = (Lp, Bs, C, cfg.num_key_value_heads, cfg.head_dim_)
+        kv_shape = (Lp, Bs, C, nkv, cfg.head_dim_)
         cache = KVCache(
             k=jnp.zeros(kv_shape, cache_dtype),
             v=jnp.zeros(kv_shape, cache_dtype),
@@ -414,22 +445,25 @@ def serve_admit(
             h_valid=h_valid, rng=rng, temp=temp, topk=topk, topp=topp,
         )
         new = jax.tree.map(
-            lambda spec, leaf: leaf[None] if spec == P(PIPE_AXIS) else leaf,
-            state_specs(state), new,
+            lambda spec, leaf: leaf[None] if _dev(spec) else leaf,
+            state_specs(state, tp), new,
         )
         return new, tok0
 
-    specs = state_specs(ServeState(*([None] * len(ServeState._fields))))
+    specs = state_specs(ServeState(*([None] * len(ServeState._fields))), tp)
     out_state, tok0 = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(
-            P(PIPE_AXIS), P(PIPE_AXIS), head_specs(head_params), specs,
+            stage_layer_specs(cfg, tp, stage_layers), P(PIPE_AXIS),
+            head_specs(head_params), specs,
             P(), P(), P(), P(), P(), P(), P(), P(), P(),
             P(),  # no-op when prompt_embeds is None (leafless pytree)
-            # prefix_kv is pipe-sharded like the serve cache ([S, Lp, ...]);
-            # both are leafless no-ops when prefix caching is off
-            P(PIPE_AXIS),
+            # prefix_kv (k, v, pos) is sharded like the serve cache ([S, Lp,
+            # ...], heads on TENSOR under tp; pos pipe-only); both entries
+            # are leafless no-ops when prefix caching is off
+            P(PIPE_AXIS) if prefix_kv is None
+            else (specs.k, specs.v, P(PIPE_AXIS)),
             P(),
         ),
         out_specs=(specs, P()),
@@ -442,7 +476,7 @@ def serve_admit(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "mesh", "num_stages"),
+    static_argnames=("cfg", "mesh", "num_stages", "tp"),
 )
 def serve_prefill_chunk(
     cfg: ModelConfig,
@@ -459,6 +493,7 @@ def serve_prefill_chunk(
     chunk_off: jnp.ndarray,  # scalar int32 cache write offset of this chunk
     reset: jnp.ndarray,      # scalar bool — first chunk zeroes the slot rows
     num_stages: int,
+    tp: int = 1,
 ):
     """One bounded chunk of an admission prefill (r2 weak #4 / next-#4).
 
@@ -471,7 +506,7 @@ def serve_prefill_chunk(
     land exactly at ``write_off[slot]``, which the next chunk (or the
     injection step) overwrites before anything attends it.
     """
-    fns = model_fns(cfg)
+    fns = model_fns(cfg, tp_axis=TENSOR_AXIS if tp > 1 else None)
     Bs, Sc = tokens.shape
     ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
 
@@ -482,8 +517,8 @@ def serve_prefill_chunk(
         hd = local_view(head_params)
         sidx = jax.lax.axis_index(PIPE_AXIS)
         st = jax.tree.map(
-            lambda spec, leaf: leaf[0] if spec == P(PIPE_AXIS) else leaf,
-            state_specs(state), state,
+            lambda spec, leaf: leaf[0] if _dev(spec) else leaf,
+            state_specs(state, tp), state,
         )
         row0 = slot * Bs
         k_rows = jax.lax.dynamic_slice_in_dim(st.k, row0, Bs, axis=1)
@@ -520,16 +555,17 @@ def serve_prefill_chunk(
             k=k_new, v=v_new, kpos=kpos_new, write_off=write_off, out=out
         )
         return jax.tree.map(
-            lambda spec, leaf: leaf[None] if spec == P(PIPE_AXIS) else leaf,
-            state_specs(state), new,
+            lambda spec, leaf: leaf[None] if _dev(spec) else leaf,
+            state_specs(state, tp), new,
         )
 
-    specs = state_specs(ServeState(*([None] * len(ServeState._fields))))
+    specs = state_specs(ServeState(*([None] * len(ServeState._fields))), tp)
     return jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(
-            P(PIPE_AXIS), P(PIPE_AXIS), head_specs(head_params), specs,
+            stage_layer_specs(cfg, tp, stage_layers), P(PIPE_AXIS),
+            head_specs(head_params), specs,
             P(), P(), P(), P(), P(),
         ),
         out_specs=specs,
@@ -539,7 +575,7 @@ def serve_prefill_chunk(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "mesh", "num_stages")
+    jax.jit, static_argnames=("cfg", "mesh", "num_stages", "tp")
 )
 def serve_admit_finish(
     cfg: ModelConfig,
@@ -556,6 +592,7 @@ def serve_admit_finish(
     top_k: jnp.ndarray,       # [Bs] int32 (0 → off)
     top_p: jnp.ndarray,       # [Bs] f32 (1.0 → off)
     num_stages: int,
+    tp: int = 1,
 ):
     """Arm a chunk-prefilled slot: park each row's final prompt token in the
     injection path at position ``prompt_len - 1``. The slot's first
@@ -574,8 +611,8 @@ def serve_admit_finish(
         hd = local_view(head_params)
         sidx = jax.lax.axis_index(PIPE_AXIS)
         st = jax.tree.map(
-            lambda spec, leaf: leaf[0] if spec == P(PIPE_AXIS) else leaf,
-            state_specs(state), state,
+            lambda spec, leaf: leaf[0] if _dev(spec) else leaf,
+            state_specs(state, tp), state,
         )
         row0 = slot * Bs
 
@@ -624,11 +661,11 @@ def serve_admit_finish(
             topk=topk, topp=topp, h_valid=h_valid,
         )
         return jax.tree.map(
-            lambda spec, leaf: leaf[None] if spec == P(PIPE_AXIS) else leaf,
-            state_specs(state), new,
+            lambda spec, leaf: leaf[None] if _dev(spec) else leaf,
+            state_specs(state, tp), new,
         )
 
-    specs = state_specs(ServeState(*([None] * len(ServeState._fields))))
+    specs = state_specs(ServeState(*([None] * len(ServeState._fields))), tp)
     return jax.shard_map(
         body,
         mesh=mesh,
@@ -645,7 +682,7 @@ def serve_admit_finish(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "cfg", "mesh", "num_stages", "n_micro", "sampling", "filtering",
+        "cfg", "mesh", "num_stages", "n_micro", "sampling", "filtering", "tp",
     ),
 )
 def serve_chunk(
@@ -659,6 +696,7 @@ def serve_chunk(
     n_micro: int,
     sampling: bool = False,
     filtering: bool = True,
+    tp: int = 1,
 ):
     """Run ``n_micro`` interleaved microsteps on the live state. Returns
     ``(state, log)`` where ``log`` is ``[n_micro, Bs]`` int32 — the token
@@ -675,7 +713,7 @@ def serve_chunk(
     seeded sampler. The host flips it the first time a temperature>0 request
     is admitted (one extra compile, then cached). ``filtering`` likewise
     compiles the top-k/top-p machinery in only when some request uses it."""
-    fns = model_fns(cfg)
+    fns = model_fns(cfg, tp_axis=TENSOR_AXIS if tp > 1 else None)
     ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
     last = num_stages - 1
     M = state.out.shape[0]
@@ -687,8 +725,8 @@ def serve_chunk(
         hd = local_view(head_params)
         sidx = jax.lax.axis_index(PIPE_AXIS)
         st = jax.tree.map(
-            lambda spec, leaf: leaf[0] if spec == P(PIPE_AXIS) else leaf,
-            state_specs(state), state,
+            lambda spec, leaf: leaf[0] if _dev(spec) else leaf,
+            state_specs(state, tp), state,
         )
 
         def micro(_, s: ServeState) -> ServeState:
@@ -837,16 +875,19 @@ def serve_chunk(
         log0 = jnp.full((n_micro, Bs), -1, jnp.int32)
         st, log = jax.lax.fori_loop(0, n_micro, micro_carry, (st, log0))
         st = jax.tree.map(
-            lambda spec, leaf: leaf[None] if spec == P(PIPE_AXIS) else leaf,
-            state_specs(state), st,
+            lambda spec, leaf: leaf[None] if _dev(spec) else leaf,
+            state_specs(state, tp), st,
         )
         return st, log
 
-    specs = state_specs(ServeState(*([None] * len(ServeState._fields))))
+    specs = state_specs(ServeState(*([None] * len(ServeState._fields))), tp)
     return jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(PIPE_AXIS), P(PIPE_AXIS), head_specs(head_params), specs),
+        in_specs=(
+            stage_layer_specs(cfg, tp, stage_layers), P(PIPE_AXIS),
+            head_specs(head_params), specs,
+        ),
         out_specs=(specs, P()),
         check_vma=False,
     )(stage_layers, layer_masks, head_params, state)

@@ -545,12 +545,18 @@ def measure_hop_latency(
     repeats: int = 30,
     dtype=jnp.bfloat16,
 ) -> HopLatencyReport:
-    """Time a chain of ``n_hops`` dependent ring permutes of a decode-shaped
+    """Time chains of dependent ring permutes of a decode-shaped
     ``[batch, 1, hidden]`` block and report per-hop percentiles.
 
     Hops are made data-dependent (the permuted block feeds the next permute)
-    so XLA cannot overlap them; dividing by ``n_hops`` amortizes dispatch
-    overhead out of the per-hop figure.
+    so XLA cannot overlap them. Each sample is the DIFFERENCE method: a long
+    chain minus a short chain, divided by the hop delta — dispatch overhead
+    and the host↔device sync cost cancel. The sync itself FETCHES a few
+    bytes of the result: on the tunneled chip ``block_until_ready`` returns
+    immediately without proving execution finished, so wall-clocking it
+    measures nothing (see bench.py's kernel timing for the same discipline).
+    ``n_hops`` is the short-chain length; the long chain is auto-scaled so
+    the hop-work delta dwarfs sync jitter (~tens of ms on a tunnel).
     """
     from jax.sharding import PartitionSpec as P
 
@@ -559,27 +565,53 @@ def measure_hop_latency(
     S = mesh.shape[PIPE_AXIS]
     ring = [(i, (i + 1) % S) for i in range(S)]
 
-    def body(h):
-        def hop(_, x):
-            return jax.lax.ppermute(x, PIPE_AXIS, ring)
+    def make_prog(n):
+        def body(h):
+            def hop(_, x):
+                return jax.lax.ppermute(x, PIPE_AXIS, ring)
 
-        return jax.lax.fori_loop(0, n_hops, hop, h)
+            return jax.lax.fori_loop(0, n, hop, h)
 
-    prog = jax.jit(
-        jax.shard_map(
-            body, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False
+        return jax.jit(
+            jax.shard_map(
+                body, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False
+            )
         )
-    )
+
     h = jnp.ones((batch, 1, hidden_size), dtype)
-    _timeit(lambda: prog(h))  # compile + warm
+
+    def run(prog):
+        t0 = time.perf_counter()
+        np.asarray(jax.device_get(prog(h)[0, 0, :8]))  # fetch-sync
+        return time.perf_counter() - t0
+
+    short = make_prog(n_hops)
+    run(short)  # compile + warm
+    # calibrate the long chain: target ≥ ~0.4 s of pure hop work so the
+    # per-sample delta is far above sync jitter, capped at 1M hops. The
+    # estimate must itself come from a CHAIN DELTA — t_short alone is
+    # sync-dominated on a tunneled chip (~100 ms RTT vs µs of hops), which
+    # would size n_long orders of magnitude too small and leave every
+    # sample pure jitter.
+    mid = make_prog(n_hops * 8)
+    run(mid)  # compile + warm
+    d = min(run(mid) - run(short) for _ in range(3))
+    per_hop_est = max(d / (7 * n_hops), 20e-9)
+    n_long = int(min(max(n_hops * 8, 0.4 / per_hop_est), 1_000_000))
+    long = make_prog(n_long)
+    run(long)  # compile + warm
     samples_us = np.array(
-        [_timeit(lambda: prog(h)) / n_hops * 1e6 for _ in range(repeats)]
+        [
+            (run(long) - run(short)) / (n_long - n_hops) * 1e6
+            for _ in range(repeats)
+        ]
     )
+    samples_us = np.maximum(samples_us, 0.0)  # jitter can cross zero on CPU
     return HopLatencyReport(
         p50_us=float(np.percentile(samples_us, 50)),
         p99_us=float(np.percentile(samples_us, 99)),
         mean_us=float(samples_us.mean()),
         bytes_per_hop=int(batch * hidden_size * jnp.dtype(dtype).itemsize),
-        hops_per_sample=n_hops,
+        hops_per_sample=n_long - n_hops,
         samples=repeats,
     )

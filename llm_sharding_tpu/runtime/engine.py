@@ -436,8 +436,29 @@ class PipelineEngine:
     ):
         """Build a continuous-batching server over this engine's sharded
         arrays (≙ the reference's persistent ``run_worker_loop`` daemon,
-        ``node_worker.py:493-559``). See ``runtime/server.py``."""
-        self._require_pipe_only("serve")
+        ``node_worker.py:493-559``). See ``runtime/server.py``.
+
+        Composes with tensor parallelism: a pp×tp engine serves with
+        megatron-sharded stage fns and a heads-sharded KV state (the serve
+        programs take ``tp``). In-program data parallelism does not — use
+        ``runtime.replicated.ReplicatedServer`` (which itself forwards
+        ``tensor_parallel``, so dp×pp×tp serving is replica × this)."""
+        if self.data_parallel > 1:
+            raise NotImplementedError(
+                "serve on an in-program dp engine: use "
+                "runtime.replicated.ReplicatedServer — D replica servers "
+                "over disjoint device groups behind a router (it forwards "
+                "tensor_parallel, so dp×pp×tp serving is replicas of a "
+                "pp×tp server)"
+            )
+        if self.tensor_parallel > 1 and self.cfg.model_type != "llama":
+            raise NotImplementedError(
+                "serve×tp supports the llama family (llama/qwen2): the "
+                "engine stores llama weights megatron-pre-split, while "
+                "gpt2's fused qkv is column-permuted inside "
+                "pipeline_generate — its serve-side permutation is not "
+                "implemented"
+            )
         from .server import PipelineServer
 
         return PipelineServer(
@@ -552,12 +573,12 @@ class PipelineEngine:
     def _require_pipe_only(self, what: str) -> None:
         if self.data_parallel > 1 or self.tensor_parallel > 1:
             raise NotImplementedError(
-                f"{what} runs on a pipe-only (or pipe×tp via "
-                "ReplicatedServer) engine; in-program dp/tp hybrid engines "
-                "support generate_ids (the shard_map pipeline program). For "
-                "data-parallel continuous batching use "
-                "runtime.replicated.ReplicatedServer — D replica servers "
-                "over disjoint device groups behind a router."
+                f"{what} runs on a pipe-only engine; in-program dp/tp hybrid "
+                "engines support generate_ids (the shard_map pipeline "
+                "program) and serve() composes with tp. For data-parallel "
+                "continuous batching use runtime.replicated.ReplicatedServer "
+                "— D replica servers over disjoint device groups behind a "
+                "router."
             )
 
     def _require_tokenizer(self):

@@ -34,7 +34,18 @@ from ..models.config import ModelConfig
 from ..parallel.placement import PlacementSpec
 
 from .engine import PipelineEngine
-from .server import PipelineServer, Request
+from .server import PipelineServer, PrefixHandle, Request
+
+
+class ReplicatedPrefixHandle:
+    """A shared prefix prefilled on EVERY replica (each replica's handle
+    lives on its own device group). ``submit(prefix=...)`` resolves it to
+    the routed replica's local handle."""
+
+    __slots__ = ("per_server",)
+
+    def __init__(self, per_server: dict):
+        self.per_server = per_server  # id(PipelineServer) → PrefixHandle
 
 
 class ReplicatedServer:
@@ -48,6 +59,7 @@ class ReplicatedServer:
         *,
         data_parallel: int,
         num_stages: Optional[int] = None,
+        tensor_parallel: int = 1,
         placement: Optional[PlacementSpec] = None,
         devices: Optional[list] = None,
         tokenizer: Any = None,
@@ -76,6 +88,7 @@ class ReplicatedServer:
                 cfg,
                 host_params,
                 num_stages=num_stages,
+                tensor_parallel=tensor_parallel,
                 placement=placement,
                 devices=devices[d * group : (d + 1) * group],
                 tokenizer=tokenizer,
@@ -109,8 +122,31 @@ class ReplicatedServer:
                 return self.servers[i]
         return self.servers[0]  # unreachable
 
+    def prefill_prefix(self, prefix_ids) -> ReplicatedPrefixHandle:
+        """Prefill a shared prefix once PER REPLICA (a system prompt is
+        served from every replica, so each caches its own copy — D small
+        prefills paid once, then every routed request skips it)."""
+        return ReplicatedPrefixHandle(
+            {id(s): s.prefill_prefix(prefix_ids) for s in self.servers}
+        )
+
     def submit(self, prompt_ids, max_new_tokens: int = 128, **kw) -> Request:
         s = self._pick()
+        pfx = kw.get("prefix")
+        if isinstance(pfx, ReplicatedPrefixHandle):
+            local = pfx.per_server.get(id(s))
+            if local is None:
+                raise ValueError(
+                    "ReplicatedPrefixHandle belongs to a different "
+                    "ReplicatedServer (handles die with the server that "
+                    "built them — re-run prefill_prefix)"
+                )
+            kw["prefix"] = local
+        elif isinstance(pfx, PrefixHandle):
+            raise ValueError(
+                "a bare PrefixHandle is bound to one replica's devices — "
+                "use ReplicatedServer.prefill_prefix"
+            )
         req = s.submit(prompt_ids, max_new_tokens, **kw)
         self._owner[req] = s
         return req

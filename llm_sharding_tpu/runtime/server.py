@@ -144,7 +144,7 @@ class Request:
     __slots__ = (
         "id", "prompt", "prompt_len", "max_new", "tokens", "done", "row",
         "temperature", "seed", "top_k", "top_p", "stop", "stop_checked",
-        "embeds", "submitted_at", "started_at", "finished_at",
+        "embeds", "prefix", "submitted_at", "started_at", "finished_at",
         "__weakref__",  # the dp router tracks request→replica ownership
     )
 
@@ -159,10 +159,12 @@ class Request:
         top_p: float = 1.0,
         stop: tuple = (),
         embeds: Optional[np.ndarray] = None,  # [S, H] privacy entry
+        prefix: Optional["PrefixHandle"] = None,  # shared-prefix KV handle
     ):
         self.id = rid
         self.prompt = prompt
         self.embeds = embeds
+        self.prefix = prefix
         self.prompt_len = int(
             prompt.shape[0] if embeds is None else embeds.shape[0]
         )
@@ -179,6 +181,27 @@ class Request:
         self.submitted_at = time.perf_counter()
         self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
+
+
+class PrefixHandle:
+    """Device-resident KV of a SHARED PREFIX, prefilled once by
+    ``PipelineServer.prefill_prefix``. Requests submitted with it
+    (``submit(suffix_ids, prefix=handle)``) skip the prefix's prefill
+    entirely: admission seeds each slot row's cache from this handle and
+    prefills only the suffix at absolute positions ``n + i`` — an N-request
+    batch over one system prompt pays the prompt's FLOPs once (≙ the
+    per-node KV the reference keeps per request, ``node_worker.py:184,
+    253-258``, lifted to a cross-request shared object).
+
+    Handles are bound to the server's current placement (the KV is
+    pipe-sharded per stage); build a new one after ``apply_placement``."""
+
+    __slots__ = ("kv", "n", "spx")
+
+    def __init__(self, kv, n: int, spx: int):
+        self.kv = kv  # (k, v, pos) pipe-sharded device arrays
+        self.n = n  # real prefix token count (positions resume at n)
+        self.spx = spx  # padded prefix bucket — cache rows it occupies
 
 
 class PipelineServer:
@@ -205,6 +228,9 @@ class PipelineServer:
         self.cfg = engine.cfg
         self.mesh = engine.mesh
         self.num_stages = self.mesh.shape[PIPE_AXIS]
+        # tensor-parallel degree: the serve programs run megatron-sharded
+        # stage fns and keep the KV state heads-sharded over TENSOR_AXIS
+        self.tp = int(getattr(engine, "tensor_parallel", 1))
         self.batch_per_slot = batch_per_slot
         self.capacity = capacity
         self.chunk_cycles = chunk_cycles
@@ -259,6 +285,7 @@ class PipelineServer:
             batch_per_slot=batch_per_slot,
             cache_dtype=engine.cache_dtype,
             act_dtype=act_dtype,
+            tp=self.tp,
         )
 
         M = self.num_stages * batch_per_slot
@@ -300,6 +327,7 @@ class PipelineServer:
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
         stop=None,  # iterable of stop STRINGS (host-side, needs a tokenizer)
+        prefix: Optional[PrefixHandle] = None,
     ) -> Request:
         """Enqueue a request (≙ ``receive_user_request``, admission happens
         on the next ``step``). ``temperature > 0`` samples with this
@@ -307,18 +335,46 @@ class PipelineServer:
         ``generate(..., temperature=, top_k=, top_p=, seed=)`` at B=1.
         ``top_k``/``top_p`` default to the server's constructor values; they
         are per-row DYNAMIC state, so mixed settings share one compiled
-        program."""
+        program.
+
+        With ``prefix`` (a ``prefill_prefix`` handle), ``prompt_ids`` is the
+        SUFFIX only — generation is token-exact vs submitting
+        ``prefix_ids + prompt_ids`` whole, but admission skips the prefix's
+        prefill. Only same-handle requests co-admit into one slot batch."""
         top_k, top_p = self._resolve_filters(top_k, top_p)
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
-        self._validate_budget(
-            self._bucket(prompt.shape[0]), max_new_tokens, chunkable=True
-        )
+        if prefix is None:
+            self._validate_budget(
+                self._bucket(prompt.shape[0]), max_new_tokens, chunkable=True
+            )
+        else:
+            if prompt.shape[0] < 1:
+                raise ValueError(
+                    "prefix requests need a non-empty suffix (the first "
+                    "token is sampled from the suffix's last position)"
+                )
+            # prefix admissions are always one-shot (suffixes are short by
+            # design); cache rows = padded prefix + suffix bucket + decode
+            bucket = self._bucket(prompt.shape[0])
+            if prefix.spx + bucket + max_new_tokens > self.capacity:
+                raise ValueError(
+                    f"prefix rows ({prefix.spx}) + suffix bucket ({bucket}) "
+                    f"+ max_new ({max_new_tokens}) exceeds server capacity "
+                    f"({self.capacity})"
+                )
+            total_pos = prefix.n + bucket + max_new_tokens
+            if total_pos > self.cfg.max_position_embeddings:
+                raise ValueError(
+                    f"requested {total_pos} positions > "
+                    f"max_position_embeddings "
+                    f"({self.cfg.max_position_embeddings})"
+                )
         stop = self._validate_stop(stop)
         with self._mutex:
             req = Request(
                 next(self._ids), prompt, max_new_tokens,
                 temperature=temperature, seed=seed, top_k=top_k, top_p=top_p,
-                stop=stop,
+                stop=stop, prefix=prefix,
             )
             if temperature > 0:
                 self._sampling = True
@@ -331,6 +387,40 @@ class PipelineServer:
             req.id, req.prompt_len, max_new_tokens, len(self._queue),
         )
         return req
+
+    def prefill_prefix(self, prefix_ids) -> PrefixHandle:
+        """Prefill a shared prefix ONCE and return its KV handle (prefix
+        caching — the serve-level answer to N requests over one system
+        prompt). The prefix is padded to an admission bucket so repeated
+        prefixes of similar length share one compiled shape; positions for
+        suffix requests resume at the REAL length ``n``, so generation is
+        token-exact vs prefilling ``prefix + suffix`` whole."""
+        prefix = np.asarray(prefix_ids, np.int32).reshape(-1)
+        n = int(prefix.shape[0])
+        if n < 1:
+            raise ValueError("prefix must be non-empty")
+        spx = self._bucket(n)
+        if spx + 1 > self.capacity:
+            raise ValueError(
+                f"prefix bucket ({spx}) exceeds server capacity "
+                f"({self.capacity})"
+            )
+        buf = np.zeros((1, spx), np.int32)
+        buf[0, :n] = prefix
+        kv = serve_ops.prefix_prefill(
+            self.cfg,
+            self.mesh,
+            self.engine.stage_layers,
+            self.engine.layer_masks,
+            self.engine.head_params,
+            jnp.asarray(buf),
+            jnp.asarray(n, jnp.int32),
+            self.num_stages,
+            self.engine.cache_dtype,
+            tp=self.tp,
+        )
+        logger.info("prefill_prefix n=%d bucket=%d", n, spx)
+        return PrefixHandle(kv, n, spx)
 
     def submit_embedding(
         self,
@@ -418,6 +508,7 @@ class PipelineServer:
                     self.num_stages * self.chunk_cycles,
                     self._sampling,
                     self._filtering,
+                    tp=self.tp,
                 )
                 self._pending.append(
                     ("chunk", self._prefetcher.fetch(log), self._m)
@@ -613,14 +704,18 @@ class PipelineServer:
             # FIFO stays honest: we take the longest same-bucket prefix.
             bucket = self._bucket(self._queue[0].prompt_len)
             # embeds requests co-admit only with embeds requests: the two
-            # entries are different compiled admission programs
+            # entries are different compiled admission programs. Prefix
+            # requests co-admit only with the SAME handle — the slot's cache
+            # rows are all seeded from one prefix KV.
             is_emb = self._queue[0].embeds is not None
+            pfx = self._queue[0].prefix
             batch: list[Request] = [self._queue.popleft()]
             while (
                 len(batch) < Bs
                 and self._queue
                 and self._bucket(self._queue[0].prompt_len) == bucket
                 and (self._queue[0].embeds is not None) == is_emb
+                and self._queue[0].prefix is pfx
             ):
                 batch.append(self._queue.popleft())
             prompts = np.zeros((Bs, bucket), np.int32)
@@ -650,9 +745,12 @@ class PipelineServer:
                 r.row = slot * Bs + i
                 r.started_at = time.perf_counter()
                 self._rows[r.row] = r
-                self._mirror_len[r.row] = r.prompt_len
-                self._mirror_budget[r.row] = r.prompt_len + r.max_new
-            if not is_emb and self._chunked(bucket):
+                # mirrors track TOTAL (prefix-inclusive) lengths — they
+                # replay the device's absolute-position bookkeeping
+                pfx_n = 0 if pfx is None else pfx.n
+                self._mirror_len[r.row] = pfx_n + r.prompt_len
+                self._mirror_budget[r.row] = pfx_n + r.prompt_len + r.max_new
+            if not is_emb and pfx is None and self._chunked(bucket):
                 self._admit_chunked(
                     slot, prompts, plen, row_valid, max_new, seeds, temps,
                     topks, topps,
@@ -680,6 +778,11 @@ class PipelineServer:
                         None if embeds is None else jnp.asarray(embeds)
                     ),
                     filtering=self._filtering,
+                    prefix_kv=None if pfx is None else pfx.kv,
+                    prefix_len=(
+                        None if pfx is None else jnp.asarray(pfx.n, jnp.int32)
+                    ),
+                    tp=self.tp,
                 )
                 # the admission-sampled first token is applied like a chunk
                 # log — deferred, so its fetch also overlaps device compute
@@ -733,6 +836,7 @@ class PipelineServer:
                 jnp.asarray(off, jnp.int32),
                 jnp.asarray(ci == 0),
                 self.num_stages,
+                tp=self.tp,
             )
             # interleave only when some OTHER request is mid-decode — the
             # admitting rows themselves are in _rows already and must not
@@ -749,6 +853,7 @@ class PipelineServer:
                     self.num_stages,  # one ring cycle between chunks
                     self._sampling,
                     self._filtering,
+                    tp=self.tp,
                 )
                 self._pending.append(
                     ("chunk", self._prefetcher.fetch(log), self._m)
@@ -772,6 +877,7 @@ class PipelineServer:
             jnp.asarray(topks),
             jnp.asarray(topps),
             self.num_stages,
+            tp=self.tp,
         )
         self._admitting_rows.difference_update(range(row0, row0 + Bs))
 

@@ -19,6 +19,22 @@ os.environ["XLA_FLAGS"] = (
 )
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Free compiled XLA:CPU executables between test modules. The suite
+    compiles hundreds of distinct programs; past ~180 tests in one process
+    the CPU backend segfaults inside backend_compile (deterministic by
+    position, not by test — an accumulation limit, observed r5 when the
+    suite grew to 193 tests). Dropping dead executables per module keeps the
+    process far from the edge; live fixtures just recompile on next use."""
+    yield
+    import gc
+
+    jax.clear_caches()
+    gc.collect()

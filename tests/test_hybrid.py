@@ -105,8 +105,10 @@ def test_engine_dp_x_pp_token_exact(setup):
     for r in range(4):
         oracle = generate(CFG, params, prompts[r], 7, cache_dtype=jnp.float32)
         np.testing.assert_array_equal(res.tokens[r], oracle.tokens[0])
-    # pipe-only surfaces refuse clearly instead of producing garbage
-    with pytest.raises(NotImplementedError, match="pipe-only"):
+    # non-composing surfaces refuse clearly instead of producing garbage
+    # (serve composes with tp since r5, but in-program dp still routes to
+    # ReplicatedServer)
+    with pytest.raises(NotImplementedError, match="ReplicatedServer"):
         eng.serve()
     with pytest.raises(NotImplementedError, match="pipe-only"):
         eng.generate_many(prompts, 4)

@@ -1,0 +1,117 @@
+"""serve × tensor parallelism (VERDICT r4 #5): the continuous-batching
+server on a pp×tp engine — megatron-sharded stage fns, heads-sharded KV
+state — token-exact vs the monolith, and dp×pp×tp via ReplicatedServer's
+``tensor_parallel`` forwarding."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from llm_sharding_tpu.models import llama
+from llm_sharding_tpu.models.config import tiny_llama
+from llm_sharding_tpu.runtime.engine import PipelineEngine
+from llm_sharding_tpu.runtime.generate import generate
+
+CFG = tiny_llama(num_hidden_layers=8)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = llama.init_params(CFG, jax.random.key(9), dtype=jnp.float32)
+    eng = PipelineEngine(
+        CFG, params, num_stages=2, tensor_parallel=2,
+        devices=jax.devices()[:4], cache_dtype=jnp.float32,
+    )
+    return params, eng
+
+
+def oracle(params, p, n, **kw):
+    res = generate(CFG, params, p, n, cache_dtype=jnp.float32, **kw)
+    return list(res.tokens[0, len(p): int(res.lengths[0])])
+
+
+def test_serve_tp_token_exact(setup):
+    """pp2×tp2 on 4 devices: staggered requests (one joins mid-decode),
+    greedy + seeded sampled, each token-exact vs the monolith."""
+    params, eng = setup
+    srv = eng.serve(capacity=64)
+    rng = np.random.default_rng(31)
+    pa = rng.integers(1, CFG.vocab_size, 5).astype(np.int32)
+    pb = rng.integers(1, CFG.vocab_size, 3).astype(np.int32)
+    pc = rng.integers(1, CFG.vocab_size, 6).astype(np.int32)
+    ra = srv.submit(pa, max_new_tokens=12)
+    rb = srv.submit(pb, max_new_tokens=10, temperature=0.9, seed=4)
+    for _ in range(3):
+        srv.step()
+    rc = srv.submit(pc, max_new_tokens=8)  # joins mid-decode
+    srv.run_until_idle()
+    assert ra.tokens == oracle(params, pa, 12)
+    assert rb.tokens == oracle(params, pb, 10, temperature=0.9, seed=4)
+    assert rc.tokens == oracle(params, pc, 8)
+
+
+def test_serve_tp_prefix_cache(setup):
+    """Prefix caching composes with tp: the prefix KV handle is
+    heads-sharded like the serve state."""
+    params, eng = setup
+    srv = eng.serve(capacity=128)
+    rng = np.random.default_rng(33)
+    prefix = rng.integers(1, CFG.vocab_size, 12).astype(np.int32)
+    h = srv.prefill_prefix(prefix)
+    sfx = rng.integers(1, CFG.vocab_size, 5).astype(np.int32)
+    r = srv.submit(sfx, max_new_tokens=9, prefix=h)
+    srv.run_until_idle()
+    assert r.tokens == oracle(params, np.concatenate([prefix, sfx]), 9)
+
+
+def test_serve_tp_chunked_admission(setup):
+    """Chunked prefill admission under tp (serve_prefill_chunk +
+    serve_admit_finish take the tp path too)."""
+    params, eng = setup
+    srv = eng.serve(capacity=128, prefill_chunk=16)
+    rng = np.random.default_rng(35)
+    p_long = rng.integers(1, CFG.vocab_size, 40).astype(np.int32)
+    p_short = rng.integers(1, CFG.vocab_size, 4).astype(np.int32)
+    rs = srv.submit(p_short, max_new_tokens=20)
+    for _ in range(2):
+        srv.step()
+    rl = srv.submit(p_long, max_new_tokens=6)  # chunked admit mid-decode
+    srv.run_until_idle()
+    assert rs.tokens == oracle(params, p_short, 20)
+    assert rl.tokens == oracle(params, p_long, 6)
+
+
+def test_replicated_tp_serve_token_exact():
+    """dp2 × (pp2×tp2) on 8 devices: ReplicatedServer forwards
+    tensor_parallel; requests land on both replicas, all token-exact."""
+    from llm_sharding_tpu.runtime.replicated import ReplicatedServer
+
+    params = llama.init_params(CFG, jax.random.key(15), dtype=jnp.float32)
+    srv = ReplicatedServer(
+        CFG, params, data_parallel=2, num_stages=2, tensor_parallel=2,
+        cache_dtype=jnp.float32, capacity=64,
+    )
+    assert all(e.tensor_parallel == 2 for e in srv.engines)
+    rng = np.random.default_rng(37)
+    prompts = [rng.integers(1, CFG.vocab_size, int(n)).astype(np.int32)
+               for n in rng.integers(3, 7, 4)]
+    reqs = [srv.submit(p, 8) for p in prompts]
+    srv.run_until_idle()
+    for r, p in zip(reqs, prompts):
+        assert r.tokens == oracle(params, p, 8), f"req {r.id}"
+    assert all(s.counters.requests_completed > 0 for s in srv.servers)
+
+
+def test_serve_tp_gpt2_rejected():
+    from llm_sharding_tpu.models import gpt2
+    from llm_sharding_tpu.models.config import tiny_gpt2
+
+    cfg = tiny_gpt2()
+    params = gpt2.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    eng = PipelineEngine(
+        cfg, params, num_stages=2, tensor_parallel=2,
+        devices=jax.devices()[:4], cache_dtype=jnp.float32,
+    )
+    with pytest.raises(NotImplementedError, match="serve×tp"):
+        eng.serve(capacity=32)
