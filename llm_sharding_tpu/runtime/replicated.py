@@ -45,7 +45,10 @@ class ReplicatedPrefixHandle:
     __slots__ = ("per_server",)
 
     def __init__(self, per_server: dict):
-        self.per_server = per_server  # id(PipelineServer) → PrefixHandle
+        # keyed by the server OBJECT (not id()): keeps the replicas the
+        # handle was built for alive, so a recycled address can never alias
+        # a stale handle onto a new server
+        self.per_server = per_server  # PipelineServer → PrefixHandle
 
 
 class ReplicatedServer:
@@ -127,14 +130,14 @@ class ReplicatedServer:
         served from every replica, so each caches its own copy — D small
         prefills paid once, then every routed request skips it)."""
         return ReplicatedPrefixHandle(
-            {id(s): s.prefill_prefix(prefix_ids) for s in self.servers}
+            {s: s.prefill_prefix(prefix_ids) for s in self.servers}
         )
 
     def submit(self, prompt_ids, max_new_tokens: int = 128, **kw) -> Request:
         s = self._pick()
         pfx = kw.get("prefix")
         if isinstance(pfx, ReplicatedPrefixHandle):
-            local = pfx.per_server.get(id(s))
+            local = pfx.per_server.get(s)
             if local is None:
                 raise ValueError(
                     "ReplicatedPrefixHandle belongs to a different "

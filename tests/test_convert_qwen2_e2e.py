@@ -1,0 +1,128 @@
+"""Qwen2 through the FULL store path (VERDICT r4 #8): a genuine HF
+Qwen2ForCausalLM multi-shard checkpoint → ``convert_hf_checkpoint`` →
+``.npz`` shard store → ``PipelineEngine.from_shards`` → pipelined generate
+== HF ``model.generate``. The r4 family was parity-tested from in-memory
+state dicts only; this proves the qkv biases survive the disk round-trip
+and the megatron TP specs (``parallel/tensor.py:59-64``). ≙ the reference's
+ModelSharder consuming real checkpoints (`model_sharder.py:27-46`)."""
+
+import os
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from llm_sharding_tpu.utils.shard_store import convert_hf_checkpoint
+
+
+@pytest.fixture(scope="module")
+def qwen2_checkpoint(tmp_path_factory):
+    import torch
+    from tokenizers import Tokenizer, models, pre_tokenizers
+    from transformers import (
+        PreTrainedTokenizerFast,
+        Qwen2Config,
+        Qwen2ForCausalLM,
+    )
+
+    torch.manual_seed(13)
+    vocab = {c: i + 3 for i, c in enumerate("abcdefghijklmnopqrstuvwxyz ")}
+    vocab.update({"[UNK]": 0, "[BOS]": 1, "[EOS]": 2})
+    hf_cfg = Qwen2Config(
+        vocab_size=len(vocab),
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=8,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        tie_word_embeddings=False,
+        use_sliding_window=False,
+        bos_token_id=1,
+        eos_token_id=2,
+    )
+    model = Qwen2ForCausalLM(hf_cfg).eval()
+
+    t = Tokenizer(models.WordLevel(vocab, unk_token="[UNK]"))
+    t.pre_tokenizer = pre_tokenizers.Split("", "isolated")
+    tokenizer = PreTrainedTokenizerFast(
+        tokenizer_object=t, unk_token="[UNK]", bos_token="[BOS]",
+        eos_token="[EOS]",
+    )
+
+    d = str(tmp_path_factory.mktemp("hf_qwen2") / "tiny-qwen2-multishard")
+    model.save_pretrained(d, max_shard_size="100KB")
+    tokenizer.save_pretrained(d)
+    return d, model, tokenizer
+
+
+def _hf_text(model, tokenizer, prompt, max_new):
+    import torch
+
+    ids = torch.tensor([tokenizer(prompt)["input_ids"]])
+    with torch.no_grad():
+        out = model.generate(
+            ids, max_new_tokens=max_new, do_sample=False,
+            pad_token_id=model.config.eos_token_id,
+        )
+    return tokenizer.decode(out[0, ids.shape[1]:], skip_special_tokens=True)
+
+
+def test_qwen2_checkpoint_multishard_with_biases(qwen2_checkpoint):
+    d, model, _ = qwen2_checkpoint
+    st = [f for f in os.listdir(d) if f.endswith(".safetensors")]
+    assert len(st) > 1, f"expected multi-shard, got {st}"
+    # the property this family adds: q/k/v biased, o not
+    sd = model.state_dict()
+    assert "model.layers.0.self_attn.q_proj.bias" in sd
+    assert "model.layers.0.self_attn.o_proj.bias" not in sd
+
+
+def test_qwen2_convert_load_serve_matches_hf(qwen2_checkpoint, tmp_path):
+    from llm_sharding_tpu.runtime.engine import PipelineEngine
+
+    d, model, tokenizer = qwen2_checkpoint
+    out = str(tmp_path / "store")
+    cfg = convert_hf_checkpoint(d, out, dtype=jnp.float32)
+    assert cfg.attention_bias, "qwen2 mapping must carry attention_bias"
+
+    eng = PipelineEngine.from_shards(out, num_stages=4, dtype=jnp.float32)
+    assert eng.tokenizer is not None
+    prompt = "the quick brown fox"
+    assert eng.generate_text(prompt, 16) == _hf_text(
+        model, tokenizer, prompt, 16
+    )
+
+
+def test_qwen2_store_serves_with_tp(qwen2_checkpoint, tmp_path):
+    """pp2×tp2 from the same store: the bq/bk/bv biases take the
+    column-parallel specs (sharded with their columns), bo is absent."""
+    from llm_sharding_tpu.runtime.engine import PipelineEngine
+
+    d, model, tokenizer = qwen2_checkpoint
+    out = str(tmp_path / "store_tp")
+    convert_hf_checkpoint(d, out, dtype=jnp.float32)
+    eng = PipelineEngine.from_shards(
+        out, num_stages=2, tensor_parallel=2, dtype=jnp.float32,
+    )
+    prompt = "hello world"
+    assert eng.generate_text(prompt, 12) == _hf_text(
+        model, tokenizer, prompt, 12
+    )
+
+
+def test_qwen2_int8_store_servable(qwen2_checkpoint, tmp_path):
+    """int8 conversion of a biased family: weights quantize, biases stay
+    raw, the store loads and serves."""
+    from llm_sharding_tpu.ops.quant import QTensor
+    from llm_sharding_tpu.runtime.engine import PipelineEngine
+    from llm_sharding_tpu.utils import shard_store
+
+    d, _, _ = qwen2_checkpoint
+    out = str(tmp_path / "store_int8")
+    convert_hf_checkpoint(d, out, dtype=jnp.float32, quantize=True)
+    _, params = shard_store.load_full(out, dtype=jnp.float32)
+    assert isinstance(params["layers"]["wq"], QTensor)
+    assert not isinstance(params["layers"]["bq"], QTensor)
+    eng = PipelineEngine.from_shards(out, num_stages=4, dtype=jnp.float32)
+    assert isinstance(eng.generate_text("the quick brown fox", 8), str)

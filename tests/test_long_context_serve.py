@@ -1,0 +1,62 @@
+"""Long-context serving proof (VERDICT r4 #7): a 16k-token prompt admitted
+through the SHARED continuous-batching server in bounded prefill chunks,
+concurrently with a live short stream — both token-exact vs the monolith.
+r3 built the 32k admit-bucket ladder (``runtime/server.py:ADMIT_BUCKETS``);
+this is the first test that actually drives it past ~2k."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from llm_sharding_tpu.models import llama
+from llm_sharding_tpu.models.config import tiny_llama
+from llm_sharding_tpu.runtime.engine import PipelineEngine
+from llm_sharding_tpu.runtime.generate import generate
+
+# positions must reach 16k+decode; the model is as shallow as the block
+# machinery allows (2 layers — scan, ragged masks and the cache contract are
+# depth-independent) so the 16k×16k attention FLOPs stay CPU-feasible: the
+# suite pays ~10 min for this file, the property tested is the 16k ADMISSION
+# PATH, not model depth
+CFG = tiny_llama(num_hidden_layers=2, max_position_embeddings=32768)
+
+
+def oracle(params, p, n):
+    res = generate(CFG, params, p, n, cache_dtype=jnp.float32)
+    return list(res.tokens[0, len(p): int(res.lengths[0])])
+
+
+def test_long_prompt_chunked_admission_16k():
+    params = llama.init_params(CFG, jax.random.key(29), dtype=jnp.float32)
+    eng = PipelineEngine(CFG, params, num_stages=2, cache_dtype=jnp.float32)
+    srv = eng.serve(capacity=16448, prefill_chunk=2048)
+    rng = np.random.default_rng(41)
+
+    p_short = rng.integers(1, CFG.vocab_size, 6).astype(np.int32)
+    r_short = srv.submit(p_short, max_new_tokens=16)
+    for _ in range(3):
+        srv.step()  # short request is mid-decode
+    tokens_before = len(r_short.tokens)
+
+    p_long = rng.integers(1, CFG.vocab_size, 16000).astype(np.int32)
+    r_long = srv.submit(p_long, max_new_tokens=4)  # bucket 16384, 8 chunks
+    srv.run_until_idle()
+
+    assert r_short.tokens == oracle(params, p_short, 16)
+    assert r_long.tokens == oracle(params, p_long, 4)
+    # the short stream kept producing: chunked admission interleaves decode
+    # cycles, so a 16k admission never freezes live requests to completion
+    assert len(r_short.tokens) > tokens_before
+
+
+def test_long_prompt_one_shot_admission_4k():
+    """The non-chunked path at 4k: one-shot bucket-4096 admission."""
+    params = llama.init_params(CFG, jax.random.key(31), dtype=jnp.float32)
+    eng = PipelineEngine(CFG, params, num_stages=2, cache_dtype=jnp.float32)
+    srv = eng.serve(capacity=4160)
+    rng = np.random.default_rng(43)
+    p = rng.integers(1, CFG.vocab_size, 4000).astype(np.int32)
+    r = srv.submit(p, max_new_tokens=4)
+    srv.run_until_idle()
+    assert r.tokens == oracle(params, p, 4)
