@@ -50,6 +50,11 @@ class ModelConfig:
     tie_word_embeddings: bool = False
     attention_bias: bool = False
     mlp_bias: bool = False
+    # llama-family block variants (Gemma: gelu MLP, sqrt(H)-scaled
+    # embeddings, RMSNorm computing out*(offset+w) in fp32)
+    hidden_act: str = "silu"  # "silu" | "gelu_tanh"
+    norm_offset: float = 0.0
+    embed_multiplier: float = 1.0
     # GPT-2 specifics
     layer_norm_epsilon: float = 1e-5
     # Token ids. ``eos_token_ids`` holds ALL stop ids (Llama-3.x instruct
@@ -100,7 +105,37 @@ class ModelConfig:
                 )
             hf = dict(hf, model_type="llama", attention_bias=True)
             mt = "llama"
+        if mt == "gemma":
+            # Gemma-1 is the llama block with three deltas (HF
+            # modeling_gemma.py): gelu-tanh MLP activation, embeddings
+            # scaled by sqrt(hidden), and RMSNorm out*(1+w) in fp32; always
+            # tied embeddings, explicit head_dim (256). Gemma-2's softcaps /
+            # alternating sliding window are a different block — refused.
+            act = hf.get("hidden_activation") or hf.get(
+                "hidden_act", "gelu_pytorch_tanh"
+            )
+            if act not in ("gelu_pytorch_tanh", "gelu", "gelu_tanh"):
+                raise ValueError(f"gemma activation {act!r} not supported")
+            if "final_logit_softcapping" in hf or "sliding_window" in hf:
+                raise ValueError(
+                    "gemma-2 (softcapping / sliding window) is not "
+                    "supported; this maps gemma-1 checkpoints"
+                )
+            hf = dict(
+                hf,
+                model_type="llama",
+                hidden_act="gelu_tanh",
+                norm_offset=1.0,
+                embed_multiplier=float(hf["hidden_size"]) ** 0.5,
+                tie_word_embeddings=True,
+            )
+            mt = "llama"
         if mt in ("llama",):
+            act = hf.get("hidden_act", "silu")
+            if act not in ("silu", "gelu_tanh"):
+                raise ValueError(
+                    f"unsupported llama-family hidden_act {act!r}"
+                )
             rs = None
             raw_rs = hf.get("rope_scaling")
             if raw_rs:
@@ -141,6 +176,9 @@ class ModelConfig:
                 tie_word_embeddings=hf.get("tie_word_embeddings", False),
                 attention_bias=hf.get("attention_bias", False),
                 mlp_bias=hf.get("mlp_bias", False),
+                hidden_act=act,
+                norm_offset=hf.get("norm_offset", 0.0),
+                embed_multiplier=hf.get("embed_multiplier", 1.0),
                 bos_token_id=hf.get("bos_token_id", 1),
                 eos_token_id=eos_ids[0],
                 eos_token_ids=eos_ids,
@@ -258,6 +296,47 @@ def qwen25_7b() -> ModelConfig:
     })
 
 
+def gemma_2b() -> ModelConfig:
+    """Gemma-2B (fourth model family): MQA (1 kv head), head_dim 256
+    decoupled from hidden/heads, gelu MLP, scaled embeddings, tied head."""
+    return ModelConfig.from_hf_config({
+        "model_type": "gemma",
+        "vocab_size": 256000,
+        "hidden_size": 2048,
+        "intermediate_size": 16384,
+        "num_hidden_layers": 18,
+        "num_attention_heads": 8,
+        "num_key_value_heads": 1,
+        "head_dim": 256,
+        "max_position_embeddings": 8192,
+        "rms_norm_eps": 1e-6,
+        "rope_theta": 10000.0,
+        "hidden_act": "gelu_pytorch_tanh",
+        "bos_token_id": 2,
+        "eos_token_id": 1,
+    })
+
+
+def gemma_7b() -> ModelConfig:
+    """Gemma-7B."""
+    return ModelConfig.from_hf_config({
+        "model_type": "gemma",
+        "vocab_size": 256000,
+        "hidden_size": 3072,
+        "intermediate_size": 24576,
+        "num_hidden_layers": 28,
+        "num_attention_heads": 16,
+        "num_key_value_heads": 16,
+        "head_dim": 256,
+        "max_position_embeddings": 8192,
+        "rms_norm_eps": 1e-6,
+        "rope_theta": 10000.0,
+        "hidden_act": "gelu_pytorch_tanh",
+        "bos_token_id": 2,
+        "eos_token_id": 1,
+    })
+
+
 def tiny_qwen2(**kw) -> ModelConfig:
     """Tiny qwen2-layout config (llama + qkv biases) for CPU tests."""
     base = dict(
@@ -284,6 +363,28 @@ def tiny_llama(**kw) -> ModelConfig:
         num_attention_heads=4,
         num_key_value_heads=2,
         max_position_embeddings=128,
+    )
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+def tiny_gemma(**kw) -> ModelConfig:
+    """Tiny gemma-layout config (llama block + gelu MLP + scaled embeddings
+    + offset RMSNorm + tied head, explicit head_dim) for CPU tests."""
+    base = dict(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=32,  # decoupled from hidden/heads like the real family
+        max_position_embeddings=128,
+        rms_norm_eps=1e-6,
+        hidden_act="gelu_tanh",
+        norm_offset=1.0,
+        embed_multiplier=64.0 ** 0.5,
+        tie_word_embeddings=True,
     )
     base.update(kw)
     return ModelConfig(**base)

@@ -135,7 +135,7 @@ def attn_mlp_block(
     Nh = out_dim(p["wq"]) // D
     Nkv = out_dim(p["wk"]) // D
 
-    x = rms_norm(h, p["input_norm"], cfg.rms_norm_eps)
+    x = rms_norm(h, p["input_norm"], cfg.rms_norm_eps, cfg.norm_offset)
     # Optional projection biases, keyed by PRESENCE (the Qwen2-family layout
     # biases q/k/v only — ``bq``/``bk``/``bv`` from the converter; column-
     # parallel under TP so each shard adds its slice before rope/attention)
@@ -158,12 +158,19 @@ def attn_mlp_block(
         attn_out = attn_out + p["bo"]
     h = h + attn_out
 
-    x = rms_norm(h, p["post_norm"], cfg.rms_norm_eps)
-    mlp = qmatmul(
-        jax.nn.silu(qmatmul(x, p["w_gate"]).astype(jnp.float32)).astype(x.dtype)
-        * qmatmul(x, p["w_up"]),
-        p["w_down"],
-    )
+    x = rms_norm(h, p["post_norm"], cfg.rms_norm_eps, cfg.norm_offset)
+    # gated MLP: activation per family (llama/qwen2 silu, gemma gelu-tanh).
+    # The fp32 cast is a deliberate local deviation from HF (which runs the
+    # act in model dtype): exact in the f32 parity tests, slightly more
+    # accurate than HF in bf16.
+    gate = qmatmul(x, p["w_gate"]).astype(jnp.float32)
+    if cfg.hidden_act == "gelu_tanh":
+        act = jax.nn.gelu(gate, approximate=True)
+    elif cfg.hidden_act == "silu":
+        act = jax.nn.silu(gate)
+    else:  # catch raw HF spellings on hand-built configs, not silently silu
+        raise ValueError(f"unsupported hidden_act {cfg.hidden_act!r}")
+    mlp = qmatmul(act.astype(x.dtype) * qmatmul(x, p["w_up"]), p["w_down"])
     if tp_axis is not None:
         mlp = jax.lax.psum(mlp, tp_axis)
     return h + mlp
@@ -233,7 +240,7 @@ def final_logits(cfg: ModelConfig, params: Params, h: jnp.ndarray) -> jnp.ndarra
     Tied checkpoints carry no ``lm_head`` array — the projection contracts
     against the embedding table directly (XLA folds the transpose into the
     matmul; no duplicate vocab×hidden buffer in HBM)."""
-    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps, cfg.norm_offset)
     if "lm_head" in params:
         return head_logits(h, params["lm_head"])
     return tied_logits(h, params["embed"])
@@ -250,5 +257,7 @@ def forward(
     (≙ ``/root/reference/inference.py`` and
     ``utils/node_profiler.py:1238-1331``)."""
     h = embed(params, token_ids)
+    if cfg.embed_multiplier != 1.0:  # gemma: hidden scaled by sqrt(H)
+        h = h * jnp.asarray(cfg.embed_multiplier, h.dtype)
     h, cache = forward_layers(cfg, params["layers"], h, cache, positions)
     return final_logits(cfg, params, h), cache

@@ -12,11 +12,18 @@ import jax
 import jax.numpy as jnp
 
 
-def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+def rms_norm(
+    x: jnp.ndarray, weight: jnp.ndarray, eps: float, offset: float = 0.0
+) -> jnp.ndarray:
+    """``offset`` reproduces families whose checkpoints store the scale as a
+    DELTA from one (Gemma: ``out * (1 + w)``, computed in fp32 like HF's
+    GemmaRMSNorm — the raw checkpoint weight stays untouched on disk)."""
     dtype = x.dtype
     x32 = x.astype(jnp.float32)
     var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
     x32 = x32 * jax.lax.rsqrt(var + eps)
+    if offset:
+        return (x32 * (offset + weight.astype(jnp.float32))).astype(dtype)
     return (x32.astype(dtype)) * weight
 
 

@@ -90,6 +90,8 @@ def _context_prefill_jit(
                 + params["pos_embed"][pos_chunk]
             )
             cos = sin = None
+        if cfg.embed_multiplier != 1.0:  # gemma: hidden scaled by sqrt(H)
+            h = h * jnp.asarray(cfg.embed_multiplier, h.dtype)
 
         def scan_body(h, p):
             h, k, v = _ctx_layer(cfg, p, h, cos, sin, pos_chunk, pos_chunk)
@@ -101,7 +103,8 @@ def _context_prefill_jit(
 
         h, ys = jax.lax.scan(scan_body, h, params["layers"])
         if cfg.model_type == "llama":
-            h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+            h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps,
+                         cfg.norm_offset)
         else:
             h = layer_norm(
                 h, params["final_norm"], params["final_norm_bias"],

@@ -161,6 +161,8 @@ def sp_embed(
         # plain indexing clamps out-of-bounds (sentinel positions of padded
         # prompt slots) exactly like the monolithic gpt2.embed
         h = h + head["pos_embed"][positions]
+    if cfg.embed_multiplier != 1.0:  # gemma: hidden scaled by sqrt(H)
+        h = h * jnp.asarray(cfg.embed_multiplier, h.dtype)
     return h
 
 
@@ -176,7 +178,8 @@ def _local_logits(
             cfg.layer_norm_epsilon,
         )
     else:
-        x = rms_norm(h_last, head["final_norm"], cfg.rms_norm_eps)
+        x = rms_norm(h_last, head["final_norm"], cfg.rms_norm_eps,
+                     cfg.norm_offset)
     if "lm_head" in head:
         logits = head_logits(x, head["lm_head"])  # [B, Vs]
     else:  # tied: contract against the local embedding slice

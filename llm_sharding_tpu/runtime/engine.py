@@ -568,6 +568,8 @@ class PipelineEngine:
         if self.cfg.model_type == "gpt2":
             pos = np.arange(ids.shape[1])
             h = h + np.asarray(self._head_host["pos_embed"])[pos][None]
+        if self.cfg.embed_multiplier != 1.0:  # gemma: hidden × sqrt(H)
+            h = h * np.asarray(self.cfg.embed_multiplier, h.dtype)
         return jnp.asarray(h)
 
     def _require_pipe_only(self, what: str) -> None:
