@@ -80,6 +80,7 @@ def _engine(args):
         placement=placement,
         num_stages=None if placement else getattr(args, "stages", None),
         dtype=_dtype(args.dtype),
+        tensor_parallel=getattr(args, "tensor_parallel", 1),
     )
 
 
@@ -242,6 +243,7 @@ def cmd_serve(args) -> int:
             cfg, params,
             data_parallel=args.data_parallel,
             num_stages=None if placement else getattr(args, "stages", None),
+            tensor_parallel=getattr(args, "tensor_parallel", 1),
             placement=placement,
             tokenizer=shard_store.load_tokenizer(args.shards),
             capacity=args.capacity,
@@ -592,6 +594,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--data-parallel", type=int, default=1, dest="data_parallel",
         help="serve N independent pipeline replicas over disjoint device "
         "groups behind a least-loaded router (runtime/replicated.py)",
+    )
+    s.add_argument(
+        "--tensor-parallel", type=int, default=1, dest="tensor_parallel",
+        help="megatron tensor parallelism per pipeline (composes with "
+        "--stages and --data-parallel: devices = dp x stages x tp)",
     )
     s.add_argument(
         "--prefill-chunk", type=int, default=None, dest="prefill_chunk",

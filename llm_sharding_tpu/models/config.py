@@ -116,7 +116,10 @@ class ModelConfig:
             )
             if act not in ("gelu_pytorch_tanh", "gelu", "gelu_tanh"):
                 raise ValueError(f"gemma activation {act!r} not supported")
-            if "final_logit_softcapping" in hf or "sliding_window" in hf:
+            # value check, not key presence: HF serializers emit null-valued
+            # keys for attributes copied across config versions
+            if (hf.get("final_logit_softcapping") is not None
+                    or hf.get("sliding_window") is not None):
                 raise ValueError(
                     "gemma-2 (softcapping / sliding window) is not "
                     "supported; this maps gemma-1 checkpoints"

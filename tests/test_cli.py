@@ -97,6 +97,29 @@ def test_serve_command_stdin(shards, capsys, monkeypatch):
     assert '"requests_completed": 2' in captured.err
 
 
+def test_serve_command_tensor_parallel(shards, capsys, monkeypatch):
+    """--tensor-parallel: the daemon serves over a pp×tp mesh (2 stages × 2
+    tensor shards on 4 devices)."""
+    from llm_sharding_tpu.runtime import engine as engine_mod
+
+    monkeypatch.setattr(
+        engine_mod.PipelineEngine,
+        "_require_tokenizer",
+        lambda self: IdTokenizer(),
+    )
+    monkeypatch.setattr("sys.stdin", io.StringIO("hi there\n"))
+    rc = cli.main(
+        [
+            "serve", shards, "--max-new", "4", "--stages", "2",
+            "--tensor-parallel", "2", "--capacity", "64", "--dtype", "f32",
+        ]
+    )
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert len([l for l in captured.out.splitlines() if l.strip()]) == 1
+    assert '"requests_completed": 1' in captured.err
+
+
 def test_profile_command_artifacts(tmp_path, capsys):
     out_dir = str(tmp_path / "prof")
     rc = cli.main(

@@ -64,6 +64,15 @@ def test_config_maps_gemma_to_llama_variant():
     assert cfg.norm_offset == 1.0
     assert cfg.embed_multiplier == pytest.approx(8.0)
     assert cfg.tie_word_embeddings and cfg.head_dim == 32
+    # null-VALUED gemma-2 keys must not trip the guard (HF serializers emit
+    # null keys for attributes copied across config versions)
+    cfg_null = ModelConfig.from_hf_config(
+        {"model_type": "gemma", "vocab_size": 8, "hidden_size": 8,
+         "intermediate_size": 8, "num_hidden_layers": 1,
+         "num_attention_heads": 1, "sliding_window": None,
+         "final_logit_softcapping": None}
+    )
+    assert cfg_null.hidden_act == "gelu_tanh"
     # gemma-2 blocks are a different architecture — refused, not mangled
     with pytest.raises(ValueError, match="gemma-2"):
         ModelConfig.from_hf_config(
