@@ -144,6 +144,8 @@ def _serve_control(eng, srv, line: str, args):
       layer→stage mapping, rebuild the continuous-batching server on it
     - ``:placement 4``        — balanced split over 4 stages
     - ``:counters``           — print the running counters
+    - ``:snapshot DIR``       — checkpoint the live daemon (device state +
+      in-flight/queued requests) to DIR; ``serve --restore DIR`` resumes it
 
     Returns the (possibly new) server.
     """
@@ -153,6 +155,18 @@ def _serve_control(eng, srv, line: str, args):
     cmd = parts[0]
     if cmd == ":counters":
         print(json.dumps(srv.counters.snapshot()), file=sys.stderr)
+        return srv
+    if cmd == ":snapshot":
+        if len(parts) < 2:
+            print("usage: :snapshot DIR", file=sys.stderr)
+            return srv
+        from .runtime.server import save_snapshot
+
+        try:
+            save_snapshot(srv.snapshot(), parts[1])
+            print(f"snapshot written to {parts[1]}", file=sys.stderr)
+        except (ValueError, RuntimeError, OSError) as e:
+            print(f"snapshot failed: {e}", file=sys.stderr)
         return srv
     if cmd == ":placement":
         if len(parts) < 2:
@@ -220,7 +234,8 @@ def _serve_control(eng, srv, line: str, args):
             file=sys.stderr,
         )
         return new_srv
-    print(f"unknown control line {cmd!r} (try :placement, :counters)",
+    print(f"unknown control line {cmd!r} (try :placement, :counters, "
+          ":snapshot)",
           file=sys.stderr)
     return srv
 
@@ -234,6 +249,17 @@ def cmd_serve(args) -> int:
         # data-parallel daemon: D replica servers over disjoint device
         # groups behind a router (runtime/replicated.py). :placement is a
         # single-engine control — not offered here.
+        if getattr(args, "restore", None):
+            # refuse loudly rather than silently starting fresh: dp restore
+            # needs one snapshot per replica (the API exists —
+            # ReplicatedServer.snapshot / restore_into — but has no
+            # single-directory CLI wiring yet)
+            print(
+                "--restore with --data-parallel is not supported from the "
+                "CLI; use ReplicatedServer.snapshot/restore_into",
+                file=sys.stderr,
+            )
+            return 2
         from .runtime.replicated import ReplicatedServer
         from .utils import shard_store
 
@@ -261,13 +287,36 @@ def cmd_serve(args) -> int:
         )
     else:
         eng = _engine(args)
-        srv = eng.serve(
-            capacity=args.capacity,
-            batch_per_slot=args.batch_per_slot,
-            prefill_chunk=args.prefill_chunk,
-            top_k=args.top_k,
-            top_p=args.top_p,
-        )
+        if getattr(args, "restore", None):
+            # resume a snapshotted daemon: in-flight requests continue
+            # token-exactly from where the snapshot left them
+            from .runtime.server import PipelineServer, load_snapshot
+
+            srv = PipelineServer.restore(eng, load_snapshot(args.restore))
+            revived = [
+                r for r in srv._rows if r is not None and not r.done
+            ] + [r for r in srv._queue]
+            print(
+                f"restored snapshot from {args.restore}: "
+                f"{len(revived)} live request(s) resume",
+                file=sys.stderr,
+            )
+            if revived:
+                # finish the snapshot's requests first; their clients are
+                # gone, so the completed text goes to stdout one per line
+                srv.run_until_idle()
+                t = eng._require_tokenizer()
+                for r in revived:
+                    print(t.decode(r.tokens, skip_special_tokens=True),
+                          flush=True)
+        else:
+            srv = eng.serve(
+                capacity=args.capacity,
+                batch_per_slot=args.batch_per_slot,
+                prefill_chunk=args.prefill_chunk,
+                top_k=args.top_k,
+                top_p=args.top_p,
+            )
         print(
             f"serving {eng.cfg.model_type} over {eng.mesh.shape} "
             f"(capacity={args.capacity}); enter a prompt, ^D to exit; "
@@ -617,6 +666,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--stop", action="append", default=None,
         help="stop string (repeatable): generation ends when the decoded "
         "text contains it",
+    )
+    s.add_argument(
+        "--restore", default=None,
+        help="resume a ':snapshot DIR' checkpoint: device serve state + "
+        "in-flight/queued requests continue token-exactly (placement and "
+        "shards must match the snapshotting daemon's)",
     )
     s.set_defaults(fn=cmd_serve)
 

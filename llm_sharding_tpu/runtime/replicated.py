@@ -208,6 +208,36 @@ class ReplicatedServer:
                 return
             self.step()
 
+    def snapshot(self) -> list:
+        """Checkpoint every replica's live serving state (see
+        ``PipelineServer.snapshot``): a list of per-replica snapshots, in
+        replica order."""
+        return [s.snapshot() for s in self.servers]
+
+    @classmethod
+    def restore_into(cls, rsrv: "ReplicatedServer", snaps: list) -> "ReplicatedServer":
+        """Resume per-replica snapshots into a freshly constructed
+        ``ReplicatedServer`` of the SAME shape (dp count, stages, tp,
+        capacity). Router ownership is rebuilt from the restored servers'
+        own rows/queues, so streaming/cancel keep working for the revived
+        requests."""
+        if len(snaps) != len(rsrv.servers):
+            raise ValueError(
+                f"{len(snaps)} replica snapshots for "
+                f"{len(rsrv.servers)} replicas"
+            )
+        restored = [
+            PipelineServer.restore(eng, snap)
+            for eng, snap in zip(rsrv.engines, snaps)
+        ]
+        rsrv.servers = restored
+        rsrv._owner = weakref.WeakKeyDictionary()
+        for s in restored:
+            for r in list(s._rows) + list(s._queue):
+                if r is not None:
+                    rsrv._owner[r] = s
+        return rsrv
+
     @property
     def counters(self):
         """Aggregated counters across replicas."""

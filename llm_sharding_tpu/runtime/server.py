@@ -138,6 +138,120 @@ class _Prefetcher:
             p.event.set()
 
 
+def save_snapshot(snap: dict, path: str) -> None:
+    """Write a ``PipelineServer.snapshot`` to ``path/`` (``state.npz`` for
+    every array, ``meta.json`` for host bookkeeping — no pickling, so a
+    snapshot from an untrusted disk cannot execute code on load). bfloat16
+    arrays (npz has no native encoding — they silently round-trip as void
+    bytes) ride as uint16 views with a dtype tag in the meta."""
+    import json as _json
+    import os
+
+    import ml_dtypes
+
+    os.makedirs(path, exist_ok=True)
+    arrays: dict = {}
+    dtags: dict = {}
+
+    def put(key: str, a) -> None:
+        a = np.asarray(a)
+        if a.dtype == ml_dtypes.bfloat16:
+            dtags[key] = "bfloat16"
+            a = a.view(np.uint16)
+        arrays[key] = a
+
+    for k, v in snap["state"].items():
+        put(f"state.{k}", v)
+    put("mirror_len", snap["mirror_len"])
+    put("mirror_budget", snap["mirror_budget"])
+
+    def enc_reqs(kind: str, reqs) -> list:
+        out = []
+        for i, d in enumerate(reqs):
+            if d is None:
+                out.append(None)
+                continue
+            e = {k: v for k, v in d.items() if k not in ("prompt", "embeds")}
+            put(f"{kind}.{i}.prompt", d["prompt"])
+            if d["embeds"] is not None:
+                put(f"{kind}.{i}.embeds", d["embeds"])
+                e["has_embeds"] = True
+            out.append(e)
+        return out
+
+    meta = {
+        "format": snap["format"],
+        "serve_kwargs": snap["serve_kwargs"],
+        "m": snap["m"],
+        "sampling": snap["sampling"],
+        "filtering": snap["filtering"],
+        "next_id": snap["next_id"],
+        "counters": snap["counters"],
+        "rows": enc_reqs("rows", snap["rows"]),
+        "queue": enc_reqs("queue", snap["queue"]),
+        "dtype_tags": dtags,
+    }
+    np.savez(os.path.join(path, "state.npz"), **arrays)
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        _json.dump(meta, f)
+
+
+def load_snapshot(path: str) -> dict:
+    """Read a ``save_snapshot`` directory back into ``restore`` input."""
+    import json as _json
+    import os
+
+    import ml_dtypes
+
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = _json.load(f)
+    dtags = meta.get("dtype_tags", {})
+    with np.load(os.path.join(path, "state.npz")) as z:
+        arrays = {
+            k: (
+                z[k].view(ml_dtypes.bfloat16)
+                if dtags.get(k) == "bfloat16" else z[k]
+            )
+            for k in z.files
+        }
+
+    def dec_reqs(kind: str, reqs) -> list:
+        out = []
+        for i, e in enumerate(reqs):
+            if e is None:
+                out.append(None)
+                continue
+            d = {k: v for k, v in e.items() if k != "has_embeds"}
+            d["prompt"] = arrays[f"{kind}.{i}.prompt"]
+            d["embeds"] = (
+                arrays[f"{kind}.{i}.embeds"] if e.get("has_embeds") else None
+            )
+            d["stop"] = tuple(d["stop"])
+            out.append(d)
+        return out
+
+    # numpy bf16 survives savez via ml_dtypes; the state dict keys are the
+    # ServeState fields
+    state = {
+        k[len("state."):]: v for k, v in arrays.items()
+        if k.startswith("state.")
+    }
+    return {
+        "format": meta["format"],
+        "serve_kwargs": meta["serve_kwargs"],
+        "state": state,
+        "m": meta["m"],
+        "sampling": meta["sampling"],
+        "filtering": meta["filtering"],
+        "mirror_len": arrays["mirror_len"],
+        "mirror_budget": arrays["mirror_budget"],
+        "rows": dec_reqs("rows", meta["rows"]),
+        "queue": dec_reqs("queue", meta["queue"]),
+        "next_id": meta["next_id"],
+        "counters": meta["counters"],
+    }
+
+
 class Request:
     """A queued/in-flight generation request."""
 
@@ -421,6 +535,164 @@ class PipelineServer:
         )
         logger.info("prefill_prefix n=%d bucket=%d", n, spx)
         return PrefixHandle(kv, n, spx)
+
+    def snapshot(self) -> dict:
+        """Checkpoint the LIVE serving daemon: the full device ``ServeState``
+        (KV caches, in-flight ring blocks, per-row bookkeeping, PRNG chains)
+        plus every host structure needed to continue — in-flight and queued
+        requests, mirrors, the microstep counter and compile-path flags.
+        ``restore`` rebuilds a server that continues every request
+        TOKEN-EXACTLY (the decode state is pure data; nothing lives in
+        program state between chunks). Extends the weights-only
+        checkpoint/resume story (``utils/shard_store``) to the serving
+        runtime itself — a failure-recovery capability the reference's
+        daemon (which holds per-request DynamicCaches in process memory,
+        ``node_worker.py:184``) cannot offer.
+
+        Taken between steps under the mutex. Refused mid-chunked-admission
+        (the slot is parked half-prefilled on device) and while queued
+        requests hold prefix handles (device-bound KV — let them admit
+        first, or resubmit them after restore)."""
+        with self._mutex:
+            if self._admitting_rows:
+                raise RuntimeError(
+                    "snapshot mid-chunked-admission is not supported — "
+                    "call between steps"
+                )
+            if any(r.prefix is not None for r in self._queue):
+                raise ValueError(
+                    "queued requests hold prefix handles (device-bound "
+                    "KV); pump until they admit or resubmit after restore"
+                )
+            self._drain(0)  # flush logs so mirrors/requests are current
+
+            def req_dict(r: Request) -> Optional[dict]:
+                if r is None:
+                    return None
+                return {
+                    "id": r.id,
+                    "prompt": np.asarray(r.prompt, np.int32),
+                    "embeds": None if r.embeds is None else np.asarray(r.embeds),
+                    "max_new": r.max_new,
+                    "temperature": r.temperature,
+                    "seed": r.seed,
+                    "top_k": r.top_k,
+                    "top_p": r.top_p,
+                    "stop": list(r.stop),
+                    "stop_checked": r.stop_checked,
+                    "tokens": list(r.tokens),
+                    "done": r.done,
+                    "row": r.row,
+                }
+
+            return {
+                "format": 1,
+                "serve_kwargs": dict(
+                    capacity=self.capacity,
+                    batch_per_slot=self.batch_per_slot,
+                    chunk_cycles=self.chunk_cycles,
+                    top_k=self.top_k,
+                    top_p=self.top_p,
+                    prefill_chunk=self.prefill_chunk,
+                    pipeline_depth=self.pipeline_depth,
+                ),
+                "state": jax.tree.map(np.asarray, self.state._asdict()),
+                "m": self._m,
+                "sampling": self._sampling,
+                "filtering": self._filtering,
+                "mirror_len": self._mirror_len.copy(),
+                "mirror_budget": self._mirror_budget.copy(),
+                "rows": [req_dict(r) for r in self._rows],
+                "queue": [req_dict(r) for r in self._queue],
+                "next_id": next(self._ids),
+                "counters": self.counters.snapshot(),
+            }
+
+    @classmethod
+    def restore(cls, engine, snap: dict) -> "PipelineServer":
+        """Rebuild a serving daemon from ``snapshot`` output over an engine
+        with the SAME model/placement (same stage count, layer split and
+        capacity — the state shapes must match; weights come from the
+        engine, so restore composes with the weights checkpoint path)."""
+        if snap.get("format") != 1:
+            raise ValueError(f"unknown snapshot format {snap.get('format')!r}")
+        srv = cls(engine, **snap["serve_kwargs"])
+        host = snap["state"]
+        # capture (shape, dtype, sharding) then FREE the zeroed template
+        # before the device_put — otherwise restore transiently holds two
+        # full serving states in HBM and can OOM where serve() alone fits
+        tmpl = {
+            name: (leaf.shape, leaf.dtype, leaf.sharding)
+            for name, leaf in zip(serve_ops.ServeState._fields, srv.state)
+        }
+        srv.state = None
+        for name, (shape, dtype, _) in tmpl.items():
+            got = tuple(np.shape(host[name]))
+            if tuple(shape) != got:
+                raise ValueError(
+                    f"snapshot state {name!r} has shape {got}, engine "
+                    f"placement expects {tuple(shape)} — restore needs the "
+                    "same stages/capacity/batch_per_slot the snapshot was "
+                    "taken with"
+                )
+            if np.asarray(host[name]).dtype != dtype:
+                raise ValueError(
+                    f"snapshot state {name!r} is "
+                    f"{np.asarray(host[name]).dtype}, engine expects {dtype} "
+                    "— restore needs the same cache/activation dtypes the "
+                    "snapshot was taken with"
+                )
+        srv.state = serve_ops.ServeState(
+            **{
+                name: jax.device_put(np.asarray(host[name]), tmpl[name][2])
+                for name in serve_ops.ServeState._fields
+            }
+        )
+        if engine.tokenizer is None and any(
+            d is not None and d["stop"]
+            for d in snap["rows"] + snap["queue"]
+        ):
+            # fail fast: stop-string checks decode text per committed token
+            raise ValueError(
+                "snapshot carries requests with stop strings but the "
+                "engine has no tokenizer (pass tokenizer= / use "
+                "from_shards on a store with tokenizer files)"
+            )
+
+        def req_from(d: Optional[dict]) -> Optional[Request]:
+            if d is None:
+                return None
+            r = Request(
+                d["id"],
+                np.asarray(d["prompt"], np.int32),
+                d["max_new"],
+                temperature=d["temperature"],
+                seed=d["seed"],
+                top_k=d["top_k"],
+                top_p=d["top_p"],
+                stop=tuple(d["stop"]),
+                embeds=None if d["embeds"] is None else np.asarray(d["embeds"]),
+            )
+            r.stop_checked = d["stop_checked"]
+            r.tokens = list(d["tokens"])
+            r.done = d["done"]
+            r.row = d["row"]
+            if r.row is not None:
+                r.started_at = time.perf_counter()
+            return r
+
+        srv._rows = [req_from(d) for d in snap["rows"]]
+        srv._queue = collections.deque(
+            req_from(d) for d in snap["queue"]
+        )
+        srv._mirror_len[:] = snap["mirror_len"]
+        srv._mirror_budget[:] = snap["mirror_budget"]
+        srv._m = snap["m"]
+        srv._sampling = snap["sampling"]
+        srv._filtering = snap["filtering"]
+        srv._ids = itertools.count(snap["next_id"])
+        srv.counters = Counters(**snap["counters"])
+        return srv
 
     def submit_embedding(
         self,

@@ -120,6 +120,44 @@ def test_serve_command_tensor_parallel(shards, capsys, monkeypatch):
     assert '"requests_completed": 1' in captured.err
 
 
+def test_serve_snapshot_restore_cli(shards, tmp_path, capsys, monkeypatch):
+    """:snapshot DIR writes a live-daemon checkpoint; serve --restore DIR
+    resumes it and keeps serving new prompts."""
+    from llm_sharding_tpu.runtime import engine as engine_mod
+
+    monkeypatch.setattr(
+        engine_mod.PipelineEngine,
+        "_require_tokenizer",
+        lambda self: IdTokenizer(),
+    )
+    d = str(tmp_path / "snap")
+    monkeypatch.setattr(
+        "sys.stdin", io.StringIO(f"first prompt\n:snapshot {d}\n")
+    )
+    rc = cli.main(
+        [
+            "serve", shards, "--max-new", "4", "--stages", "4",
+            "--capacity", "64", "--dtype", "f32",
+        ]
+    )
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert f"snapshot written to {d}" in err
+
+    monkeypatch.setattr("sys.stdin", io.StringIO("after restore\n"))
+    rc = cli.main(
+        [
+            "serve", shards, "--max-new", "4", "--stages", "4",
+            "--capacity", "64", "--dtype", "f32", "--restore", d,
+        ]
+    )
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert "restored snapshot" in captured.err
+    assert '"requests_completed": 2' in captured.err  # 1 restored + 1 new
+    assert len([l for l in captured.out.splitlines() if l.strip()]) == 1
+
+
 def test_profile_command_artifacts(tmp_path, capsys):
     out_dir = str(tmp_path / "prof")
     rc = cli.main(
