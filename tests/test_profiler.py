@@ -142,3 +142,20 @@ def test_stage_memory_quantized_head_accounting():
         CFG, 4, 1
     )
     assert mixed[0] - all_int8[0] == want_delta > 0
+
+
+def test_measure_hop_latency_ring8():
+    """The north-star secondary metric's machinery: chain-delta calibration
+    over an 8-device ring yields a positive, stable per-hop figure (the
+    difference method must survive sync jitter; samples clamp at 0 only
+    when jitter swamps the delta, which a real 8-ring never hits on CPU)."""
+    from llm_sharding_tpu.parallel.mesh import pipeline_mesh
+    from llm_sharding_tpu.profiler.profiler import measure_hop_latency
+
+    rep = measure_hop_latency(
+        pipeline_mesh(8), hidden_size=64, n_hops=32, repeats=5
+    )
+    assert rep.p50_us > 0
+    assert rep.p99_us >= rep.p50_us
+    assert rep.bytes_per_hop == 64 * 2  # bf16 block
+    assert rep.hops_per_sample > 0 and rep.samples == 5

@@ -139,6 +139,29 @@ def test_replicated_snapshot_restore(setup):
         assert got.tokens == oracle(params, p, 10)
 
 
+def test_stream_and_cancel_after_restore(setup):
+    """A restored server is fully live: its requests stream (pumping the
+    server) and cancel like freshly submitted ones."""
+    params, eng = setup
+    srv = eng.serve(capacity=64)
+    rng = np.random.default_rng(59)
+    pa = rng.integers(1, CFG.vocab_size, 4).astype(np.int32)
+    pb = rng.integers(1, CFG.vocab_size, 5).astype(np.int32)
+    ra = srv.submit(pa, max_new_tokens=10)
+    rb = srv.submit(pb, max_new_tokens=30)
+    for _ in range(3):
+        srv.step()
+    srv2 = PipelineServer.restore(eng, srv.snapshot())
+    got_a = next(r for r in srv2._rows if r is not None and r.id == ra.id)
+    got_b = next(r for r in srv2._rows if r is not None and r.id == rb.id)
+    # stream() replays from the first token — pre-restore tokens included
+    assert list(srv2.stream(got_a)) == oracle(params, pa, 10)
+    assert srv2.cancel(got_b)  # mid-decode cancel on the restored server
+    srv2.run_until_idle()
+    assert got_b.done and len(got_b.tokens) < 30
+    assert rb is not got_b  # the original object belongs to the dead server
+
+
 def test_snapshot_refuses_queued_prefix(setup):
     params, eng = setup
     srv = eng.serve(capacity=128)
