@@ -23,8 +23,9 @@ two metrics uncaptured):
 Metrics:
   a. decode_tok_s_llama3.2-3b_1chip — the no-regression ANCHOR (first+last).
   b. decode_tok_s_llama3.2-3b_1chip_c4096 — decode against a 4096-slot KV.
-  c. decode_tok_s_llama3.2-3b_1chip_b8 — batched decode (8 rows, the
-     single-chip ceiling for DP-style serving).
+  c. decode_tok_s_llama3.2-3b_1chip_b8 — batched decode (8 rows; kept for
+     cross-round continuity) and _b32 (32 rows — the single-chip ceiling
+     the serve metric is judged against).
   d. serve_tok_s_llama3.2-3b_1stage — steady-state continuous batching
      (PipelineServer: serve_admit + serve_chunk + host loop).
   e. decode_tok_s_llama3.2-3b-int8_1chip — int8-resident weights + vocab
@@ -185,6 +186,7 @@ def bench_3b(on_tpu, jax, jnp):
             "decode_tok_s_llama3.2-3b_1chip_c4096",
             "decode_tok_s_llama3.2-3b_1chip",
             "decode_tok_s_llama3.2-3b_1chip_b8",
+            "decode_tok_s_llama3.2-3b_1chip_b32",
         )
     else:
         cfg = tiny_llama()
@@ -194,6 +196,7 @@ def bench_3b(on_tpu, jax, jnp):
             "decode_tok_s_tiny_cpu_cbig",
             "decode_tok_s_tiny_cpu",
             "decode_tok_s_tiny_cpu_b2",
+            "decode_tok_s_tiny_cpu_b4",
         )
     params = llama.init_params(cfg, jax.random.key(0), dtype=jnp.bfloat16)
 
@@ -213,6 +216,17 @@ def bench_3b(on_tpu, jax, jnp):
     for name, kwargs, est in (
         (names[0], dict(capacity=big_c), 90),
         (names[2], dict(capacity=prompt_len + max_new, batch=b8), 90),
+        # 32 rows (CPU smoke: 4, matching its _b4 name): the serving
+        # ceiling the 32-row serve metric is judged against (weight reads
+        # amortize until the attention/HBM working set dominates)
+        (
+            names[3],
+            dict(
+                capacity=prompt_len + max_new,
+                batch=32 if on_tpu else 4,
+            ),
+            90,
+        ),
     ):
         if remaining() < est + 60:
             emit_skip(name, "tokens/sec", est)
@@ -241,12 +255,16 @@ def bench_serve(on_tpu, cfg, params, jax, jnp):
         "serve_tok_s_llama3.2-3b_1stage" if on_tpu else "serve_tok_s_tiny_cpu"
     )
     if on_tpu:
-        # 8 rows: decode is weight-read-bound, so rows amortize the per-step
-        # weight reads — the b8 monolith metric bounds what's reachable.
-        # chunk_cycles=8 + pipeline_depth=2: the prefetch thread issues each
-        # chunk's token-log read at dispatch time and the step loop applies
-        # it two chunks later — the tunnel RTT fully overlaps device compute.
-        batch_per_slot, capacity, chunk_cycles, depth = 8, 512, 8, 2
+        # 32 rows: decode is weight-read-bound, so rows amortize the
+        # per-step weight reads — the b32 monolith metric bounds what's
+        # reachable (state donation in the serve programs made 32 rows fit:
+        # without it input+output states coexist and 32×C KV exhausts HBM
+        # beside the 3B params). chunk_cycles=8 + pipeline_depth=2: the
+        # prefetch thread issues each chunk's token-log read at dispatch
+        # time and the step loop applies it two chunks later — the tunnel
+        # RTT fully overlaps device compute. Measured r5: 8 rows ~620,
+        # 16 ~865, 32 ~1475 tok/s.
+        batch_per_slot, capacity, chunk_cycles, depth = 32, 320, 8, 2
         prompt_len, max_new = 32, 256
     else:
         batch_per_slot, capacity, chunk_cycles, depth = 2, 64, 2, 1

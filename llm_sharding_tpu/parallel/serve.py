@@ -260,6 +260,9 @@ def serve_cancel_rows(state: ServeState, rows_mask: jnp.ndarray) -> ServeState:
     static_argnames=(
         "cfg", "mesh", "num_stages", "cache_dtype", "filtering", "tp",
     ),
+    donate_argnums=(5,),  # the previous ServeState buffers are dead on
+    # return (the server reassigns self.state) — donation halves the
+    # state's transient HBM footprint and lets XLA update in place
 )
 def serve_admit(
     cfg: ModelConfig,
@@ -477,6 +480,7 @@ def serve_admit(
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "mesh", "num_stages", "tp"),
+    donate_argnums=(5,),  # see serve_admit
 )
 def serve_prefill_chunk(
     cfg: ModelConfig,
@@ -575,7 +579,8 @@ def serve_prefill_chunk(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "mesh", "num_stages", "tp")
+    jax.jit, static_argnames=("cfg", "mesh", "num_stages", "tp"),
+    donate_argnums=(3,),  # see serve_admit
 )
 def serve_admit_finish(
     cfg: ModelConfig,
@@ -684,6 +689,7 @@ def serve_admit_finish(
     static_argnames=(
         "cfg", "mesh", "num_stages", "n_micro", "sampling", "filtering", "tp",
     ),
+    donate_argnums=(5,),  # see serve_admit
 )
 def serve_chunk(
     cfg: ModelConfig,
