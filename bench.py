@@ -41,6 +41,9 @@ Metrics:
      tokens cross-checked equal.
   k. decode_tok_s_llama3.2-3b-int4_1chip — int4 store precision at int8
      residency (backs the "int4 keeps int8 throughput" claim).
+  l. serve_tok_s_llama3.2-3b-int8_1stage — continuous batching on int8
+     weights at 64 rows (int8 halves the params' HBM footprint, so twice
+     the rows fit — the serving headline).
 
 vs_baseline for throughput metrics is tok/s over the reference world's only
 number: the ~4 tok/s anecdotal anchor (`/root/reference/start_node.py:20`
@@ -166,6 +169,7 @@ def bench_int8_variant(name, cfg, params, prompt_len, max_new, generate,
         emit(n8, tok_s8, "tokens/sec", tok_s8 / ANCHOR_TOK_S, max_new=max_new)
     except Exception as e:  # noqa: BLE001
         emit_error(n8, "tokens/sec", e)
+        return None
     return params
 
 
@@ -244,14 +248,19 @@ def bench_3b(on_tpu, jax, jnp):
     return cfg, params, names[1], tok_s
 
 
-def bench_serve(on_tpu, cfg, params, jax, jnp):
+def bench_serve(on_tpu, cfg, params, jax, jnp, *, name=None, rows=None,
+                seed=1):
     """Steady-state continuous-batching throughput on a 1-stage mesh. The
     engine is built with ``host_staging=False``: the device params from
     bench_3b are stage-stacked ON DEVICE (no host pull/push of 6+ GB
-    through the tunnel — r3's dominant serve-section cost)."""
+    through the tunnel — r3's dominant serve-section cost). ``params`` may
+    be int8 QTensors — the int8 serving metric reuses this harness with
+    ``rows=64`` (int8 halves the params' HBM footprint, so twice the rows
+    fit beside them: the serving headline, measured r5 bf16×32 ~1475 vs
+    int8×64 ~2850 tok/s)."""
     from llm_sharding_tpu.runtime.engine import PipelineEngine
 
-    name = (
+    name = name or (
         "serve_tok_s_llama3.2-3b_1stage" if on_tpu else "serve_tok_s_tiny_cpu"
     )
     if on_tpu:
@@ -264,17 +273,17 @@ def bench_serve(on_tpu, cfg, params, jax, jnp):
         # time and the step loop applies it two chunks later — the tunnel
         # RTT fully overlaps device compute. Measured r5: 8 rows ~620,
         # 16 ~865, 32 ~1475 tok/s.
-        batch_per_slot, capacity, chunk_cycles, depth = 32, 320, 8, 2
+        batch_per_slot, capacity, chunk_cycles, depth = rows or 32, 320, 8, 2
         prompt_len, max_new = 32, 256
     else:
-        batch_per_slot, capacity, chunk_cycles, depth = 2, 64, 2, 1
+        batch_per_slot, capacity, chunk_cycles, depth = rows or 2, 64, 2, 1
         prompt_len, max_new = 8, 16
 
     engine = PipelineEngine(
         cfg, params, num_stages=1, devices=jax.devices()[:1],
         host_staging=False,
     )
-    rng = np.random.default_rng(1)
+    rng = np.random.default_rng(seed)
 
     def run(n_requests, n_new):
         srv = engine.serve(
@@ -544,6 +553,10 @@ def main():
         "decode_tok_s_llama3.2-3b-int4_1chip" if on_tpu
         else "decode_tok_s_tiny-int4_cpu"
     )
+    nserve8 = (
+        "serve_tok_s_llama3.2-3b-int8_1stage" if on_tpu
+        else "serve_tok_s_tiny-int8_cpu"
+    )
     nhop = (
         "hop_latency_p50_us_1chip_loopback" if on_tpu
         else f"hop_latency_p50_us_cpu_ring{len(jax.devices())}"
@@ -597,6 +610,7 @@ def main():
         # buffers the serve engine was aliasing
         if remaining() < 120:
             emit_skip(int8_metric_name(n3b), "tokens/sec", 120)
+            emit_skip(nserve8, "tokens/sec", 180)
         else:
             from llm_sharding_tpu.runtime.generate import generate
 
@@ -607,8 +621,27 @@ def main():
             # best-of-5: this metric sits within tunnel variance of its
             # ≥195 target (measured 194.5-198.7 across runs) — more reps
             # report the chip, not the tunnel's mood, for ~9 s extra
-            bench_int8_variant(n3b, cfg3b, params3b, 32 if on_tpu else 8,
-                               448 if on_tpu else 16, generate, reps=5)
+            qparams = bench_int8_variant(
+                n3b, cfg3b, params3b, 32 if on_tpu else 8,
+                448 if on_tpu else 16, generate, reps=5,
+            )
+            # int8 serving at 64 rows rides the quantized device params
+            if qparams is None:
+                emit_error(nserve8, "tokens/sec",
+                           "not attempted: int8 quantization failed")
+            elif remaining() < 180:
+                emit_skip(nserve8, "tokens/sec", 180)
+            else:
+                try:
+                    eng8 = bench_serve(
+                        on_tpu, cfg3b, qparams, jax, jnp, name=nserve8,
+                        rows=64 if on_tpu else 2, seed=3,
+                    )
+                    del eng8
+                except Exception as e:  # noqa: BLE001
+                    emit_error(nserve8, "tokens/sec", e)
+            qparams = None
+            gc.collect()
         ret = (ret[0], None, ret[2], ret[3])  # drop the params reference
         gc.collect()
         if remaining() < 150:
@@ -624,6 +657,7 @@ def main():
         emit_error(nprefix, "x_speedup_vs_full_prefill",
                    "not attempted: 3B section failed")
         emit_error(n4, "tokens/sec", "not attempted: 3B section failed")
+        emit_error(nserve8, "tokens/sec", "not attempted: 3B section failed")
 
     if remaining() < 90:
         emit_skip(npallas, "x_speedup_vs_xla", 90)
