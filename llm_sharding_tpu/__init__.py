@@ -17,8 +17,10 @@ Public surface:
     parallel.mesh      -- mesh construction helpers
     parallel.pipeline  -- shard_map/ppermute pipeline generation
     runtime.generate   -- single-host generation (oracle + serving core)
+    obs                -- serving telemetry: metrics registry, JSONL latency
+                          spans, /metrics + /statz HTTP exposition
 """
 
-from . import models, ops, parallel, profiler, runtime, utils  # noqa: F401
+from . import models, obs, ops, parallel, profiler, runtime, utils  # noqa: F401
 
 __version__ = "0.1.0"

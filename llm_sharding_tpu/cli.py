@@ -10,7 +10,9 @@ those five entry points into subcommands:
 - ``generate`` — one prompt through the sharded pipeline (≙ ``inference.py``,
   but pipelined; ``--stream`` streams tokens from the sharded program)
 - ``serve``    — persistent interactive daemon over stdin (≙ ``start_node.py``
-  + ``run_worker_loop``), continuous batching underneath
+  + ``run_worker_loop``), continuous batching underneath; ``--metrics-port``
+  exposes /metrics (Prometheus) + /statz (JSON), ``--trace-path`` streams
+  JSONL latency spans, ``:stats`` prints the telemetry snapshot in-band
 - ``profile``  — capability sweeps, hop latency, artifacts + an optional
   capability-weighted placement suggestion (≙ ``profiling.py``; closes the
   profiler→scheduler loop of the reference's README)
@@ -144,17 +146,33 @@ def _serve_control(eng, srv, line: str, args):
       layer→stage mapping, rebuild the continuous-batching server on it
     - ``:placement 4``        — balanced split over 4 stages
     - ``:counters``           — print the running counters
+    - ``:stats``              — print the full telemetry snapshot (counters +
+      every registry metric, histograms with p50/p90/p99) as one JSON line —
+      the stdin twin of the ``--metrics-port`` HTTP ``/statz`` endpoint
     - ``:snapshot DIR``       — checkpoint the live daemon (device state +
       in-flight/queued requests) to DIR; ``serve --restore DIR`` resumes it
 
     Returns the (possibly new) server.
     """
+    from .obs.metrics import REGISTRY
     from .parallel.placement import PlacementSpec
 
     parts = line.split(None, 1)
     cmd = parts[0]
     if cmd == ":counters":
         print(json.dumps(srv.counters.snapshot()), file=sys.stderr)
+        return srv
+    if cmd == ":stats":
+        print(
+            json.dumps(
+                {
+                    "counters": srv.counters.snapshot(),
+                    "metrics": REGISTRY.json_snapshot(),
+                },
+                sort_keys=True,
+            ),
+            file=sys.stderr,
+        )
         return srv
     if cmd == ":snapshot":
         if len(parts) < 2:
@@ -197,6 +215,7 @@ def _serve_control(eng, srv, line: str, args):
                 prefill_chunk=args.prefill_chunk,
                 top_k=args.top_k,
                 top_p=args.top_p,
+                trace_path=getattr(args, "trace_path", None),
             )
 
         try:
@@ -228,6 +247,7 @@ def _serve_control(eng, srv, line: str, args):
                 f"{list(old_spec.stages)}",
                 file=sys.stderr,
             )
+        srv.close()  # the discarded server's trace writer fd, not GC's job
         new_srv.counters = counters  # session totals survive the swap
         print(
             f"placement applied: {list(applied.stages)} over {eng.mesh.shape}",
@@ -277,6 +297,7 @@ def cmd_serve(args) -> int:
             prefill_chunk=args.prefill_chunk,
             top_k=args.top_k,
             top_p=args.top_p,
+            trace_path=args.trace_path,
         )
         eng = srv.engines[0]
         print(
@@ -293,6 +314,12 @@ def cmd_serve(args) -> int:
             from .runtime.server import PipelineServer, load_snapshot
 
             srv = PipelineServer.restore(eng, load_snapshot(args.restore))
+            if args.trace_path:
+                # the snapshot's serve_kwargs never carry observability
+                # knobs — attach the trace to the revived daemon directly
+                from .obs.trace import TraceWriter
+
+                srv._trace = TraceWriter(args.trace_path)
             revived = [
                 r for r in srv._rows if r is not None and not r.done
             ] + [r for r in srv._queue]
@@ -316,6 +343,7 @@ def cmd_serve(args) -> int:
                 prefill_chunk=args.prefill_chunk,
                 top_k=args.top_k,
                 top_p=args.top_p,
+                trace_path=args.trace_path,
             )
         print(
             f"serving {eng.cfg.model_type} over {eng.mesh.shape} "
@@ -323,6 +351,19 @@ def cmd_serve(args) -> int:
             f":placement <ranges|N> re-shards live",
             file=sys.stderr,
         )
+    metrics_srv = _start_metrics(
+        getattr(args, "metrics_port", 0),
+        # late-bound: ``srv`` is rebound on :placement — the provider always
+        # reads the CURRENT server's tally (dp routers expose per-replica
+        # load too)
+        statz_extra={
+            "counters": lambda: srv.counters.snapshot(),
+            **(
+                {"replicas": lambda: srv.stats()["replicas"]}
+                if getattr(args, "data_parallel", 1) > 1 else {}
+            ),
+        },
+    )
     tok = eng._require_tokenizer()
     n_prompt = 0
     for line in sys.stdin:
@@ -354,7 +395,34 @@ def cmd_serve(args) -> int:
                 prev = text
         print(flush=True)
     print(json.dumps(srv.counters.snapshot()), file=sys.stderr)
+    if metrics_srv is not None:
+        metrics_srv.stop()
+    if hasattr(srv, "close"):
+        srv.close()  # flush the JSONL trace
     return 0
+
+
+def _start_metrics(port, statz_extra=None):
+    """Start the background ``/metrics`` + ``/statz`` exposition thread when
+    a port is requested (0/None = disabled). Returns the MetricsServer or
+    None. Bind failures (port taken) are reported and non-fatal — the daemon
+    serves without exposition rather than dying."""
+    if not port:
+        return None
+    from .obs.http import MetricsServer
+
+    try:
+        ms = MetricsServer(port=port, statz_extra=statz_extra)
+        ms.start()
+    except OSError as e:
+        print(f"metrics endpoint disabled: {e}", file=sys.stderr)
+        return None
+    print(
+        f"metrics: http://127.0.0.1:{ms.port}/metrics (Prometheus), "
+        f"/statz (JSON)",
+        file=sys.stderr,
+    )
+    return ms
 
 
 def cmd_worker(args) -> int:
@@ -380,10 +448,17 @@ def cmd_worker(args) -> int:
         f"{jax.device_count()} global devices",
         file=sys.stderr,
     )
+    # per-process exposition: base port + process id (every worker is its
+    # own scrape target, ≙ the reference's per-node logs but queryable)
+    metrics_srv = _start_metrics(
+        args.metrics_port + args.process_id if args.metrics_port else 0
+    )
     eng = _engine(args)
     text = eng.generate_text(args.prompt, args.max_new)
     if args.process_id == 0:
         print(text)
+    if metrics_srv is not None:
+        metrics_srv.stop()
     return 0
 
 
@@ -443,6 +518,9 @@ def cmd_launch(args) -> int:
                 cmd += ["--ranges", args.ranges]
             if args.local_devices:
                 cmd += ["--local-devices", str(args.local_devices)]
+            if getattr(args, "metrics_port", 0):
+                # base port; each worker binds base + its process id
+                cmd += ["--metrics-port", str(args.metrics_port)]
             log_path = os.path.join(args.log_dir, f"worker_{pid}.log")
             logs.append(log_path)
             log = stack.enter_context(open(log_path, "w"))
@@ -673,6 +751,18 @@ def build_parser() -> argparse.ArgumentParser:
         "in-flight/queued requests continue token-exactly (placement and "
         "shards must match the snapshotting daemon's)",
     )
+    s.add_argument(
+        "--metrics-port", type=int, default=0, dest="metrics_port",
+        help="serve /metrics (Prometheus text) and /statz (JSON with "
+        "p50/p90/p99 TTFT, queue-wait, inter-token latency) on "
+        "127.0.0.1:PORT from a background thread (0 = off)",
+    )
+    s.add_argument(
+        "--trace-path", default=None, dest="trace_path",
+        help="append one JSONL line per span (admit/chunk/apply/request) to "
+        "this file for offline latency analysis; with --data-parallel each "
+        "replica writes PATH.r<i>",
+    )
     s.set_defaults(fn=cmd_serve)
 
     w = sub.add_parser(
@@ -691,6 +781,10 @@ def build_parser() -> argparse.ArgumentParser:
     w.add_argument(
         "--local-devices", type=int, default=0, dest="local_devices",
         help="force N virtual CPU devices per process (simulation)",
+    )
+    w.add_argument(
+        "--metrics-port", type=int, default=0, dest="metrics_port",
+        help="expose /metrics on 127.0.0.1:(PORT + process-id) (0 = off)",
     )
     w.set_defaults(fn=cmd_worker)
 
@@ -717,6 +811,11 @@ def build_parser() -> argparse.ArgumentParser:
     la.add_argument(
         "--timeout", type=float, default=900.0,
         help="kill all workers after this many seconds (0 = no limit)",
+    )
+    la.add_argument(
+        "--metrics-port", type=int, default=0, dest="metrics_port",
+        help="base port for per-worker /metrics endpoints: worker i binds "
+        "PORT+i (0 = off)",
     )
     la.set_defaults(fn=cmd_launch)
 

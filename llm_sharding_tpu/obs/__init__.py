@@ -1,0 +1,25 @@
+"""Serving telemetry: metrics registry, latency spans, HTTP exposition.
+
+Three modules, all stdlib-only (importable before jax backend init):
+
+- ``metrics`` — thread-safe labeled counters/gauges/histograms with quantile
+  readout, Prometheus text + JSON snapshot, and the process-wide
+  ``REGISTRY`` every subsystem records into;
+- ``trace``   — JSONL span writer (one line per admit/chunk/apply/request
+  span) behind the server's ``trace_path=`` knob;
+- ``http``    — ``MetricsServer``: a background stdlib-``http.server``
+  thread serving ``/metrics`` (Prometheus), ``/statz`` (JSON) and
+  ``/healthz``, wired into the CLI via ``--metrics-port``.
+
+Metric names are documented in README.md § Observability.
+"""
+
+from .metrics import (  # noqa: F401
+    DEFAULT_LATENCY_BUCKETS,
+    DEFAULT_RATE_BUCKETS,
+    REGISTRY,
+    Registry,
+    record_shape_key,
+)
+from .trace import TraceWriter  # noqa: F401
+from .http import MetricsServer  # noqa: F401

@@ -1,0 +1,105 @@
+"""Metrics exposition over HTTP: a stdlib background thread, no deps.
+
+``MetricsServer`` serves the process-wide registry on:
+
+- ``/metrics`` — Prometheus text format 0.0.4 (scrape target);
+- ``/statz``   — JSON: the registry snapshot (histograms with p50/p90/p99)
+  plus any extra named providers (the serve daemon registers its live
+  ``Counters.snapshot`` so ``/statz`` carries the exact per-server tally);
+- ``/healthz`` — liveness probe (200 ``ok``).
+
+Wired into ``cli.py serve/worker/launch`` via ``--metrics-port``; binds
+``port=0`` to an ephemeral port (returned by ``start()``) for tests. The
+handler threads are daemons — the exposition can never keep a finished
+daemon process alive.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from .metrics import REGISTRY, Registry
+
+
+class MetricsServer:
+    def __init__(
+        self,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        registry: Optional[Registry] = None,
+        statz_extra: Optional[Dict[str, Callable[[], object]]] = None,
+    ):
+        self.registry = registry if registry is not None else REGISTRY
+        self._extra: Dict[str, Callable[[], object]] = dict(statz_extra or {})
+        self._httpd = ThreadingHTTPServer(
+            (host, port), self._handler_class()
+        )
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="obs-http"
+        )
+        self._started = False
+
+    def add_statz(self, name: str, provider: Callable[[], object]) -> None:
+        """Register (or replace) a named JSON provider under ``/statz`` —
+        e.g. the live server's counters, per-replica queue depths."""
+        self._extra[name] = provider
+
+    def start(self) -> int:
+        if not self._started:
+            self._thread.start()
+            self._started = True
+        return self.port
+
+    def stop(self) -> None:
+        if self._started:
+            self._httpd.shutdown()
+            self._started = False
+        self._httpd.server_close()
+
+    # ------------------------------------------------------------ internals
+
+    def _statz_payload(self) -> dict:
+        payload: dict = {"metrics": self.registry.json_snapshot()}
+        for name, provider in list(self._extra.items()):
+            try:
+                payload[name] = provider()
+            except Exception as e:  # noqa: BLE001 — a dead provider must
+                # not take the whole stats page down
+                payload[name] = {"error": str(e)[:200]}
+        return payload
+
+    def _handler_class(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                if path == "/metrics":
+                    body = server.registry.prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/statz":
+                    body = json.dumps(
+                        server._statz_payload(), sort_keys=True
+                    ).encode()
+                    ctype = "application/json"
+                elif path == "/healthz":
+                    body, ctype = b"ok\n", "text/plain; charset=utf-8"
+                else:
+                    self.send_error(404, "try /metrics, /statz or /healthz")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence per-request stderr spam
+                pass
+
+        return Handler

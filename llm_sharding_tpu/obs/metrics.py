@@ -1,0 +1,401 @@
+"""Process-wide metrics registry: counters, gauges, fixed-bucket histograms.
+
+The serving stack's structured replacement for the reference's tagged stdout
+prints (``node_worker.py:115-125``) and for the bare ``Counters`` tally this
+repo carried through round 5. One ``Registry`` holds every metric family;
+families are labeled (Prometheus-style), children are created on first use,
+and every mutation is lock-protected so concurrent request/pump threads sum
+exactly. Two read-out formats:
+
+- ``prometheus_text()`` — the text exposition format (scrapeable by any
+  Prometheus-compatible collector; served by ``obs.http.MetricsServer``);
+- ``json_snapshot()`` — a JSON-friendly dict with histogram quantiles
+  (p50/p90/p99, linear interpolation within the fixed buckets) for
+  ``/statz`` and the ``:stats`` daemon control command.
+
+Pure stdlib — importable from the device-program modules (parallel/serve.py)
+without dragging jax in, and safe to import before backend initialization.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+import threading
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+# Latency buckets (seconds): sub-ms host work through minute-scale compiles.
+DEFAULT_LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+# Throughput buckets (tokens/sec): CPU-smoke single digits to chip thousands.
+DEFAULT_RATE_BUCKETS = (
+    1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0,
+    1000.0, 2000.0, 5000.0, 10000.0,
+)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: integers render without the trailing .0.
+    Non-finite values (an inf/NaN observation poisons a histogram sum
+    forever) render as Prometheus spellings instead of crashing the scrape
+    — isfinite must be checked BEFORE floor (floor raises on inf/NaN)."""
+    f = float(v)
+    if not math.isfinite(f):
+        return "+Inf" if f > 0 else ("-Inf" if f < 0 else "NaN")
+    if f == math.floor(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _label_str(names: Tuple[str, ...], values: Tuple[str, ...]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(
+        f'{n}="{_escape_label(v)}"' for n, v in zip(names, values)
+    )
+    return "{" + inner + "}"
+
+
+class _CounterChild:
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counters only go up (inc by {n})")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class _GaugeChild:
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class _HistogramChild:
+    __slots__ = ("_lock", "bounds", "counts", "sum", "count")
+
+    def __init__(self, lock: threading.Lock, bounds: Tuple[float, ...]):
+        self._lock = lock
+        self.bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)  # +1: the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            i = 0
+            for i, b in enumerate(self.bounds):  # noqa: B007
+                if v <= b:
+                    break
+            else:
+                i = len(self.bounds)
+            self.counts[i] += 1
+            self.sum += v
+            self.count += 1
+
+    def snap(self):
+        """Atomic (counts, sum, count) copy — exposition must read under the
+        same lock observe() writes under, or a concurrent scrape can emit a
+        count that disagrees with its own sum/buckets."""
+        with self._lock:
+            return list(self.counts), self.sum, self.count
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimated q-quantile (0 < q <= 1) by linear interpolation within
+        the fixed buckets — the standard Prometheus ``histogram_quantile``
+        estimate, computed host-side. ``None`` with no observations; samples
+        landing in the +Inf bucket clamp to the largest finite bound."""
+        counts, _, total = self.snap()
+        return _quantile_from(self.bounds, counts, total, q)
+
+
+def _quantile_from(bounds, counts, total, q: float) -> Optional[float]:
+    if total == 0:
+        return None
+    rank = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        prev = cum
+        cum += c
+        if cum >= rank and c > 0:
+            if i >= len(bounds):
+                return bounds[-1] if bounds else None
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i]
+            return lo + (hi - lo) * (rank - prev) / c
+    return bounds[-1] if bounds else None
+
+
+_CHILD_TYPES = {
+    "counter": _CounterChild,
+    "gauge": _GaugeChild,
+    "histogram": _HistogramChild,
+}
+
+
+class _Family:
+    """One named metric family; labeled children created on first use.
+    Unlabeled families proxy ``inc/set/dec/observe/value`` straight to their
+    single child so call sites stay terse."""
+
+    def __init__(
+        self,
+        kind: str,
+        name: str,
+        help: str,
+        label_names: Tuple[str, ...],
+        buckets: Optional[Tuple[float, ...]] = None,
+    ):
+        self.kind = kind
+        self.name = name
+        self.help = help
+        self.label_names = label_names
+        self.buckets = buckets
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], object] = {}
+        if not label_names:
+            self._children[()] = self._make_child()
+
+    def _make_child(self):
+        if self.kind == "histogram":
+            return _HistogramChild(self._lock, self.buckets)
+        return _CHILD_TYPES[self.kind](self._lock)
+
+    def labels(self, *values, **kw):
+        if kw:
+            if values:
+                raise ValueError("pass label values positionally OR by name")
+            values = tuple(str(kw[n]) for n in self.label_names)
+        else:
+            values = tuple(str(v) for v in values)
+        if len(values) != len(self.label_names):
+            raise ValueError(
+                f"{self.name} takes labels {self.label_names}, got {values}"
+            )
+        with self._lock:
+            child = self._children.get(values)
+            if child is None:
+                child = self._children[values] = self._make_child()
+            return child
+
+    # unlabeled convenience proxies ------------------------------------
+    def _solo(self):
+        if self.label_names:
+            raise ValueError(f"{self.name} is labeled: use .labels(...)")
+        return self._children[()]
+
+    def inc(self, n: float = 1.0) -> None:
+        self._solo().inc(n)
+
+    def set(self, v: float) -> None:
+        self._solo().set(v)
+
+    def dec(self, n: float = 1.0) -> None:
+        self._solo().dec(n)
+
+    def observe(self, v: float) -> None:
+        self._solo().observe(v)
+
+    @property
+    def value(self) -> float:
+        return self._solo().value
+
+    def series(self):
+        with self._lock:
+            return sorted(self._children.items())
+
+
+class Registry:
+    """Thread-safe named collection of metric families. Registration is
+    get-or-create: re-registering the same (name, kind, labels) returns the
+    existing family (module reloads and multiple servers share one tally);
+    a conflicting re-registration raises."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+
+    def _register(self, kind, name, help, labels, buckets=None) -> _Family:
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        labels = tuple(labels)
+        for ln in labels:
+            if not _LABEL_RE.match(ln):
+                raise ValueError(f"invalid label name {ln!r}")
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if fam.kind != kind or fam.label_names != labels or (
+                    kind == "histogram" and fam.buckets != buckets
+                ):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{fam.kind}{fam.label_names}"
+                    )
+                return fam
+            fam = _Family(kind, name, help, labels, buckets)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help: str = "", labels: Sequence[str] = ()):
+        return self._register("counter", name, help, labels)
+
+    def gauge(self, name: str, help: str = "", labels: Sequence[str] = ()):
+        return self._register("gauge", name, help, labels)
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        labels: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS,
+    ):
+        buckets = tuple(sorted(float(b) for b in buckets))
+        if not buckets:
+            raise ValueError("histogram needs at least one finite bucket")
+        return self._register("histogram", name, help, labels, buckets)
+
+    def get(self, name: str) -> Optional[_Family]:
+        with self._lock:
+            return self._families.get(name)
+
+    def _sorted_families(self):
+        with self._lock:
+            return sorted(self._families.items())
+
+    # ------------------------------------------------------------- readout
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        out = []
+        for name, fam in self._sorted_families():
+            if fam.help:
+                out.append(f"# HELP {name} {fam.help}")
+            out.append(f"# TYPE {name} {fam.kind}")
+            for values, child in fam.series():
+                ls = _label_str(fam.label_names, values)
+                if fam.kind == "histogram":
+                    counts, total_sum, _ = child.snap()
+                    cum = 0
+                    for b, c in zip(fam.buckets, counts):
+                        cum += c
+                        le = _label_str(
+                            fam.label_names + ("le",), values + (_fmt(b),)
+                        )
+                        out.append(f"{name}_bucket{le} {cum}")
+                    cum += counts[-1]
+                    le = _label_str(
+                        fam.label_names + ("le",), values + ("+Inf",)
+                    )
+                    out.append(f"{name}_bucket{le} {cum}")
+                    out.append(f"{name}_sum{ls} {_fmt(total_sum)}")
+                    out.append(f"{name}_count{ls} {cum}")
+                else:
+                    out.append(f"{name}{ls} {_fmt(child.value)}")
+        return "\n".join(out) + "\n"
+
+    def json_snapshot(self) -> dict:
+        """JSON-friendly view: histograms carry count/sum/p50/p90/p99 and the
+        per-bucket cumulative counts; counters/gauges carry the value."""
+        snap: dict = {}
+        for name, fam in self._sorted_families():
+            series = []
+            for values, child in fam.series():
+                entry: dict = {"labels": dict(zip(fam.label_names, values))}
+                if fam.kind == "histogram":
+                    # one atomic snap feeds buckets, count, sum AND the
+                    # quantiles — the whole entry is self-consistent
+                    counts, total_sum, total = child.snap()
+                    cum, buckets = 0, {}
+                    for b, c in zip(fam.buckets, counts):
+                        cum += c
+                        buckets[_fmt(b)] = cum
+                    buckets["+Inf"] = cum + counts[-1]
+                    entry.update(
+                        count=total,
+                        sum=total_sum,
+                        p50=_quantile_from(fam.buckets, counts, total, 0.50),
+                        p90=_quantile_from(fam.buckets, counts, total, 0.90),
+                        p99=_quantile_from(fam.buckets, counts, total, 0.99),
+                        buckets=buckets,
+                    )
+                else:
+                    entry["value"] = child.value
+                series.append(entry)
+            snap[name] = {"type": fam.kind, "help": fam.help, "series": series}
+        return snap
+
+    def json_text(self) -> str:
+        return json.dumps(self.json_snapshot(), sort_keys=True)
+
+
+#: The process-wide default registry every subsystem records into. Tests
+#: that need isolation construct their own ``Registry``.
+REGISTRY = Registry()
+
+
+# -- compile/shape-key visibility -----------------------------------------
+
+_SHAPE_KEYS_SEEN: set = set()
+_SHAPE_KEYS_LOCK = threading.Lock()
+_SHAPE_KEYS = REGISTRY.counter(
+    "engine_jit_shape_keys_total",
+    "Host-side mirror of the jit program cache: first sight of a "
+    "(program, static-shape key) is a miss (a compile), repeats are hits",
+    labels=("program", "result"),
+)
+
+
+def record_shape_key(program: str, key) -> bool:
+    """Record one dispatch of a jitted serving program under its host-visible
+    shape key (the static args + array shapes that key the jit cache).
+    Returns True on a hit (the key was seen before — the compiled program is
+    reused), False on a miss (this dispatch compiles). Recompile costs stop
+    being silent: a serve daemon whose bucket ladder or placement churn keeps
+    compiling shows up as a growing ``result="miss"`` count."""
+    k = (program, key)
+    with _SHAPE_KEYS_LOCK:
+        hit = k in _SHAPE_KEYS_SEEN
+        if not hit:
+            _SHAPE_KEYS_SEEN.add(k)
+    _SHAPE_KEYS.labels(program=program, result="hit" if hit else "miss").inc()
+    return hit

@@ -1,0 +1,62 @@
+"""Structured JSONL trace events: one line per span, for offline analysis.
+
+The serving loop's phase timings (admit / chunk dispatch / log apply) and
+per-request latency spans (queue-wait, TTFT, end-to-end) stream to a file as
+they happen — ``jq``/pandas-friendly, append-only, crash-safe at line
+granularity. Enabled per server via ``PipelineServer(..., trace_path=)`` /
+``cli serve --trace-path``.
+
+Schema (one JSON object per line):
+
+    {"ts": <unix seconds, float>,   # event END time
+     "span": "<name>",              # admit | chunk | apply | request
+     "dur_s": <float>,              # span duration (absent for point events)
+     ...span-specific fields}
+
+Span fields:
+
+- ``admit``:   slot, ids, bucket, chunked, n (batch size)
+- ``chunk``:   m0 (first microstep), cycles — dur_s is HOST dispatch time
+               (the device executes asynchronously)
+- ``apply``:   applied (log entries drained) — dur_s includes the blocking
+               device fetch when the pipeline depth is exceeded
+- ``request``: id, tokens, queue_wait_s, ttft_s, tok_s — emitted at
+               completion; dur_s is submission→finish
+
+Writes are line-buffered and serialized per writer; a full line lands per
+``write`` call, so concurrent writers appending to one file (the dp daemon
+writes one file per replica instead, see runtime/replicated.py) do not
+interleave mid-line on POSIX appends.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+
+class TraceWriter:
+    """Append-only JSONL span writer; thread-safe; ``close()`` idempotent."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._f = open(path, "a", buffering=1)
+
+    def emit(self, span: str, dur_s: Optional[float] = None, **fields):
+        ev = {"ts": time.time(), "span": span}
+        if dur_s is not None:
+            ev["dur_s"] = round(float(dur_s), 6)
+        ev.update(fields)
+        line = json.dumps(ev, sort_keys=True) + "\n"
+        with self._lock:
+            if self._f is not None:
+                self._f.write(line)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None

@@ -45,6 +45,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .attention import cached_attention
+from .. import _compat
 
 # Block sizes from an on-chip sweep (v5e, llama3-8b geometry, S=C=2048,
 # device-side fori_loop timing — host timing through the tunnel is
@@ -188,7 +189,7 @@ def flash_attention(
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compat.pallas_tpu_compiler_params()(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

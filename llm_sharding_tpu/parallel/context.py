@@ -31,6 +31,7 @@ from ..ops.quant import embed_rows, head_logits, tied_logits
 from ..ops.ring_attention import ring_attention
 from ..ops.rope import rope_cos_sin
 from .mesh import SEQ_AXIS
+from .._compat import shard_map
 
 
 def _ctx_layer(cfg: ModelConfig, p: Any, h, cos, sin, q_pos, kv_pos):
@@ -134,7 +135,7 @@ def _context_prefill_jit(
 
     logits_spec = P(None, SEQ_AXIS) if full_logits else P()
     kv_spec = P(None, None, SEQ_AXIS)
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(P(), P(None, SEQ_AXIS), P(None, SEQ_AXIS), P()),

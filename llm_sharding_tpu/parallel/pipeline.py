@@ -62,6 +62,7 @@ from .head import (
     sp_sample,
 )
 from .mesh import PIPE_AXIS
+from .._compat import shard_map
 
 
 class ModelFns(NamedTuple):
@@ -355,7 +356,7 @@ def _pipeline_generate_jit(
         return state["out"], state["lengths"]
 
     batch_spec = P(DATA_AXIS) if dp > 1 else P()
-    out, lengths = jax.shard_map(
+    out, lengths = shard_map(
         body,
         mesh=mesh,
         in_specs=(

@@ -59,6 +59,7 @@ from .pipeline import (
     ring_chain,
     validate_request,
 )
+from .._compat import shard_map
 
 
 class InterleavedResult(NamedTuple):
@@ -296,7 +297,7 @@ def _interleaved_jit(
         state = jax.lax.while_loop(cond, micro, state)
         return state["out"], state["lengths"]
 
-    out, lengths = jax.shard_map(
+    out, lengths = shard_map(
         body,
         mesh=mesh,
         in_specs=(

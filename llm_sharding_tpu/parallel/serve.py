@@ -42,6 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.cache import KVCache, POS_SENTINEL
 from ..models.config import ModelConfig
+from ..obs.metrics import REGISTRY
 from ..ops.sampling import is_stop as _is_stop
 from .head import (
     head_specs, key_chain_split, local_view, psum_from, seed_chain_init,
@@ -50,6 +51,18 @@ from .head import (
 from .mesh import PIPE_AXIS
 from .pipeline import model_fns, ring_chain, stage_layer_specs
 from .tensor import TENSOR_AXIS
+from .._compat import shard_map
+
+# Admission-bucket usage, labeled by the padded prompt bucket — each label
+# value is one compiled serve_admit shape, so this counter shows which rungs
+# of the bucket ladder actually carry traffic (and which ones paid a compile
+# for nothing). Incremented host-side by PipelineServer._admit_pending; the
+# device programs below stay metric-free (nothing traceable runs in jit).
+ADMIT_BUCKET_USED = REGISTRY.counter(
+    "server_admit_bucket_total",
+    "Admissions per prompt bucket (one compiled serve_admit shape each)",
+    labels=("bucket",),
+)
 
 
 class ServeState(NamedTuple):
@@ -233,7 +246,7 @@ def prefix_prefill(
         return cache.k[None], cache.v[None], cache.pos[None]
 
     kv_spec = _kv_spec(tp)
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(
@@ -454,7 +467,7 @@ def serve_admit(
         return new, tok0
 
     specs = state_specs(ServeState(*([None] * len(ServeState._fields))), tp)
-    out_state, tok0 = jax.shard_map(
+    out_state, tok0 = shard_map(
         body,
         mesh=mesh,
         in_specs=(
@@ -564,7 +577,7 @@ def serve_prefill_chunk(
         )
 
     specs = state_specs(ServeState(*([None] * len(ServeState._fields))), tp)
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(
@@ -671,7 +684,7 @@ def serve_admit_finish(
         )
 
     specs = state_specs(ServeState(*([None] * len(ServeState._fields))), tp)
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(
@@ -887,7 +900,7 @@ def serve_chunk(
         return st, log
 
     specs = state_specs(ServeState(*([None] * len(ServeState._fields))), tp)
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(

@@ -43,6 +43,7 @@ from ..models import llama
 from ..models.cache import init_cache
 from ..models.config import ModelConfig
 from ..runtime.generate import forward_fn_for
+from .._compat import shard_map
 
 DEFAULT_PREFILL_LENGTHS = (8, 16, 32, 64, 128, 256, 512)  # ≙ node_profiler.py:14-17
 DEFAULT_REPEATS = 3
@@ -573,7 +574,7 @@ def measure_hop_latency(
             return jax.lax.fori_loop(0, n, hop, h)
 
         return jax.jit(
-            jax.shard_map(
+            shard_map(
                 body, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False
             )
         )

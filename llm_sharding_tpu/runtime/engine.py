@@ -39,6 +39,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from typing import Any, Iterator, Optional
 
 import numpy as np
@@ -46,6 +47,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.config import ModelConfig
+from ..obs.metrics import REGISTRY, record_shape_key
 from ..parallel.mesh import PIPE_AXIS, pipeline_mesh
 from ..parallel.pipeline import PipelineResult, pipeline_generate
 from ..parallel.placement import PlacementSpec, stack_stage_params
@@ -53,6 +55,21 @@ from ..utils import shard_store
 from .generate import generate
 
 logger = logging.getLogger("llm_sharding_tpu.engine")
+
+# Hot-reconfiguration visibility: placement swaps were a one-line log —
+# their count, wall cost (host staging + device_put of every stage slice)
+# and the resulting pipe depth now land in the registry, so repartition
+# churn and its cost show up next to the serving latency it perturbs.
+_M_SWAPS = REGISTRY.counter(
+    "engine_placement_swaps_total", "apply_placement calls that committed",
+)
+_M_SWAP_SECONDS = REGISTRY.histogram(
+    "engine_placement_swap_seconds",
+    "Wall time of one placement swap (stage re-slice + device placement)",
+)
+_M_STAGES = REGISTRY.gauge(
+    "engine_pipeline_stages", "Pipe-axis size of the engine's current mesh",
+)
 
 
 class PipelineEngine:
@@ -206,6 +223,7 @@ class PipelineEngine:
                 f"placement covers {spec.num_layers} layers but model has "
                 f"{self.cfg.num_hidden_layers}"
             )
+        swap_t0 = time.perf_counter()
         # A chain longer than the pipe axis executes grouped: k consecutive
         # stages per device, ppermute once per k virtual stages (r3 next-#8).
         pipe = self._pipe_size(spec.num_stages)
@@ -270,6 +288,7 @@ class PipelineEngine:
                 self.layer_masks = masks
                 self.head_params = head_params
                 self._servers = {}
+            self._record_swap(swap_t0, 1)
             logger.info(
                 "placement applied (device-resident, 1 stage): %s",
                 list(spec.stages),
@@ -327,10 +346,17 @@ class PipelineEngine:
             self.head_params = head_params
             # live servers are bound to the old arrays — invalidate
             self._servers = {}
+        self._record_swap(swap_t0, exec_spec.num_stages)
         logger.info(
             "placement applied: %d stages over %d pipe devices, ranges %s",
             spec.num_stages, exec_spec.num_stages, list(spec.stages),
         )
+
+    @staticmethod
+    def _record_swap(t0: float, pipe: int) -> None:
+        _M_SWAPS.inc()
+        _M_SWAP_SECONDS.observe(time.perf_counter() - t0)
+        _M_STAGES.set(pipe)
 
     # -- serving ------------------------------------------------------------
 
@@ -349,6 +375,21 @@ class PipelineEngine:
         with self._lock:
             stage_layers, masks = self.stage_layers, self.layer_masks
             mesh, head = self.mesh, self.head_params
+        # host-side mirror of the jit cache key: a repartition that keeps
+        # (stages, batch, lengths) static REUSES the compiled program — this
+        # makes that reuse (or a recompile) visible as a hit/miss metric.
+        # Normalized the way pipeline_generate normalizes, so equivalent
+        # calls ((S,) vs (1, S) prompts, capacity=None vs its resolved
+        # value) don't count phantom misses.
+        shape = tuple(np.shape(prompt_ids))
+        if len(shape) == 1:
+            shape = (1,) + shape
+        record_shape_key(
+            "pipeline_generate",
+            (mesh.shape[PIPE_AXIS], shape, int(max_new_tokens),
+             capacity or (shape[-1] + int(max_new_tokens)),
+             int(masks.shape[1])),
+        )
         return pipeline_generate(
             self.cfg,
             mesh,
@@ -433,6 +474,7 @@ class PipelineEngine:
         top_p: float = 1.0,
         prefill_chunk: Optional[int] = None,
         pipeline_depth: int = 1,
+        trace_path: Optional[str] = None,
     ):
         """Build a continuous-batching server over this engine's sharded
         arrays (≙ the reference's persistent ``run_worker_loop`` daemon,
@@ -470,6 +512,7 @@ class PipelineEngine:
             top_p=top_p,
             prefill_chunk=prefill_chunk,
             pipeline_depth=pipeline_depth,
+            trace_path=trace_path,
         )
 
     def _shared_server(self, prompt_len: int, max_new: int):

@@ -84,6 +84,10 @@ class ReplicatedServer:
         # numpy arrays (its np.asarray staging is then a no-op) and
         # device_puts onto its own group only
         host_params = jax.tree.map(np.asarray, params)
+        # one JSONL trace file PER REPLICA (suffix .r<d>): replicas step on
+        # independent threads of control — a shared file would interleave
+        # their spans with no way to attribute them
+        trace_path = serve_kwargs.pop("trace_path", None)
         self.engines: list[PipelineEngine] = []
         self.servers: list[PipelineServer] = []
         for d in range(data_parallel):
@@ -98,7 +102,14 @@ class ReplicatedServer:
                 cache_dtype=cache_dtype or jnp.bfloat16,
             )
             self.engines.append(eng)
-            self.servers.append(eng.serve(**serve_kwargs))
+            self.servers.append(
+                eng.serve(
+                    trace_path=(
+                        f"{trace_path}.r{d}" if trace_path else None
+                    ),
+                    **serve_kwargs,
+                )
+            )
         self.data_parallel = data_parallel
         self._rr = 0
         # request → owning replica (weak keys: entries vanish with requests)
@@ -248,3 +259,26 @@ class ReplicatedServer:
             for k, v in s.counters.snapshot().items():
                 setattr(agg, k, getattr(agg, k) + v)
         return agg
+
+    def close(self) -> None:
+        """Flush every replica's JSONL trace (no-op without trace_path)."""
+        for s in self.servers:
+            s.close()
+
+    def stats(self) -> dict:
+        """Router-level view for ``/statz``: the aggregate counter snapshot
+        plus per-replica counters and load (queued + in-flight), so an
+        operator can see a hot or stuck replica instead of only the sum."""
+        return {
+            "counters": self.counters.snapshot(),
+            "replicas": [
+                {
+                    "counters": s.counters.snapshot(),
+                    "queued": len(s._queue),
+                    "in_flight": sum(
+                        r is not None and not r.done for r in s._rows
+                    ),
+                }
+                for s in self.servers
+            ],
+        }

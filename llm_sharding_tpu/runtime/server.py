@@ -25,9 +25,15 @@ Streaming (``stream()``) yields token ids as chunks complete — the sharded
 pipeline IS the streaming path; the full model never lands on one device
 (the round-1 gap flagged in VERDICT #3/#5 and ADVICE).
 
-Observability (VERDICT #10): a module logger emits one-line summaries per
-admission and completion plus chunk-rate diagnostics; ``Counters`` is a
-queryable running tally (requests, tokens, chunks, admissions).
+Observability (VERDICT #10, closed by the ``obs/`` subsystem): every request
+records queue-wait, TTFT, per-token inter-arrival and end-to-end latency
+into the process-wide metrics registry (histograms with p50/p90/p99
+readout); every step records admit/dispatch/apply phase durations;
+``trace_path=`` streams one JSONL line per span for offline analysis; and
+``Counters`` remains the queryable per-server running tally, re-backed on
+the registry (each bump mirrors to a ``server_*_total`` counter). Serve the
+registry over HTTP with ``obs.MetricsServer`` (CLI: ``--metrics-port`` →
+``/metrics`` Prometheus text, ``/statz`` JSON).
 """
 
 from __future__ import annotations
@@ -39,16 +45,82 @@ import logging
 import queue
 import threading
 import time
+import weakref
 from typing import Iterator, Optional, Sequence
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..obs.metrics import DEFAULT_RATE_BUCKETS, REGISTRY, record_shape_key
+from ..obs.trace import TraceWriter
 from ..parallel import serve as serve_ops
 from ..parallel.mesh import PIPE_AXIS
 
 logger = logging.getLogger("llm_sharding_tpu.server")
+
+# -- serving telemetry (obs/): process-wide latency spans and gauges --------
+_M_QUEUE_WAIT = REGISTRY.histogram(
+    "server_queue_wait_seconds",
+    "Submission-to-admission wait per request",
+)
+_M_TTFT = REGISTRY.histogram(
+    "server_ttft_seconds",
+    "Submission to first committed token per request (includes queue wait)",
+)
+_M_INTERTOKEN = REGISTRY.histogram(
+    "server_intertoken_seconds",
+    "Host-visible gap between a request's consecutive committed tokens "
+    "(tokens apply per chunk log: intra-chunk gaps ~0, inter-chunk gaps = "
+    "chunk wall time)",
+)
+_M_REQUEST = REGISTRY.histogram(
+    "server_request_seconds",
+    "Submission-to-completion wall time per request",
+)
+_M_TOK_S = REGISTRY.histogram(
+    "server_request_tok_s",
+    "Per-request decode rate over its admission-to-finish window",
+    buckets=DEFAULT_RATE_BUCKETS,
+)
+_M_STEP_PHASE = REGISTRY.histogram(
+    "server_step_phase_seconds",
+    "Serving-loop phase durations: admit (prefill dispatch incl. the "
+    "pre-admission log flush), dispatch (host-side chunk dispatch; the "
+    "device executes async), apply (log drain incl. any blocking fetch)",
+    labels=("phase",),
+)
+_M_QUEUE_DEPTH = REGISTRY.gauge(
+    "server_queue_depth",
+    "Requests waiting for a free slot, summed over live servers",
+)
+_M_ACTIVE = REGISTRY.gauge(
+    "server_slots_active",
+    "Slot rows holding a live (not done) request, summed over live servers",
+)
+# Every live server in the process (dp replicas, the capacity ladder): the
+# load gauges report the SUM over them — a per-server .set() would clobber,
+# exposing whichever replica updated last instead of the daemon's backlog.
+# Weak refs: discarded servers (repartition, ladder rebuild) drop out on GC.
+_LIVE_SERVERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _update_load_gauges() -> None:
+    """Recompute the process-wide load gauges from every live server. Reads
+    other servers' queue/rows without their mutex — len() and the row scan
+    are safe against torn reads, and a gauge one step stale is fine."""
+    queued = active = 0
+    for s in list(_LIVE_SERVERS):
+        queued += len(s._queue)
+        active += sum(r is not None and not r.done for r in s._rows)
+    _M_QUEUE_DEPTH.set(queued)
+    _M_ACTIVE.set(active)
+
+
+_M_FETCH_FAIL = REGISTRY.counter(
+    "server_fetch_failures_total",
+    "Prefetched device-to-host reads that raised (chunk logs, admit tokens)",
+)
 
 # Admission prompt buckets: each one a compiled serve_admit shape (compiles
 # happen only for buckets actually used; the ladder tops out at 32k so long-
@@ -61,7 +133,13 @@ ADMIT_BUCKETS = (
 @dataclasses.dataclass
 class Counters:
     """Queryable running totals (≙ the reference's tagged stdout prints,
-    ``node_worker.py:115-125`` — but structured)."""
+    ``node_worker.py:115-125`` — but structured). Re-backed on the metrics
+    registry: ``inc`` bumps the per-server field AND mirrors into the
+    process-wide ``server_<field>_total`` counter, so ``/metrics`` carries
+    the same tallies without touching the public ``snapshot()`` API or the
+    server checkpoint format (direct field writes — aggregation, restore —
+    deliberately do NOT mirror; the registry counts this process's live
+    serving activity)."""
 
     requests_submitted: int = 0
     requests_completed: int = 0
@@ -73,6 +151,29 @@ class Counters:
     def snapshot(self) -> dict:
         return dataclasses.asdict(self)
 
+    def inc(self, field: str, n: int = 1) -> None:
+        setattr(self, field, getattr(self, field) + n)
+        _FIELD_COUNTERS[field].inc(n)
+
+    @classmethod
+    def from_snapshot(cls, snap: dict) -> "Counters":
+        """Forward/backward-compatible construction: unknown keys in the
+        snapshot are ignored (an OLD build loading a NEW snapshot) and
+        missing fields default to 0 (a NEW build loading an OLD snapshot) —
+        ``Counters(**snap)`` raised TypeError the moment a counter field
+        landed or left."""
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in snap.items() if k in known})
+
+
+_FIELD_COUNTERS = {
+    f.name: REGISTRY.counter(
+        f"server_{f.name}_total",
+        f"Process total of Counters.{f.name} across live servers",
+    )
+    for f in dataclasses.fields(Counters)
+}
+
 
 class _Prefetched:
     """A device→host read issued eagerly on a background thread. The serving
@@ -83,10 +184,11 @@ class _Prefetched:
     queue stays full (measured: the synchronous fetch cost ~36 ms of the
     ~240 ms serve iteration on the tunneled chip)."""
 
-    __slots__ = ("handle", "value", "error", "event")
+    __slots__ = ("handle", "value", "error", "event", "tag")
 
-    def __init__(self, handle):
+    def __init__(self, handle, tag: str = "?"):
         self.handle = handle
+        self.tag = tag  # what this read belongs to ("chunk m0=…", "admit …")
         self.value = None
         self.error: Optional[BaseException] = None
         self.event = threading.Event()
@@ -94,7 +196,13 @@ class _Prefetched:
     def get(self) -> np.ndarray:
         self.event.wait()
         if self.error is not None:
-            raise self.error
+            # name the chunk/admission the failed device→host read belonged
+            # to — a bare re-raise surfaced "transfer failed" with no way to
+            # tell WHICH of the in-flight logs died
+            raise RuntimeError(
+                f"prefetched device read failed for {self.tag}: "
+                f"{self.error!r}"
+            ) from self.error
         return self.value
 
 
@@ -122,8 +230,8 @@ class _Prefetcher:
                 cls._instance = cls()
             return cls._instance
 
-    def fetch(self, handle) -> _Prefetched:
-        p = _Prefetched(handle)
+    def fetch(self, handle, tag: str = "?") -> _Prefetched:
+        p = _Prefetched(handle, tag)
         self._q.put(p)
         return p
 
@@ -134,6 +242,8 @@ class _Prefetcher:
                 p.value = np.asarray(p.handle)
             except BaseException as e:  # noqa: BLE001 — surfaced via get()
                 p.error = e
+                _M_FETCH_FAIL.inc()
+                logger.warning("prefetch failed for %s: %r", p.tag, e)
             p.handle = None  # drop the device reference promptly
             p.event.set()
 
@@ -259,6 +369,7 @@ class Request:
         "id", "prompt", "prompt_len", "max_new", "tokens", "done", "row",
         "temperature", "seed", "top_k", "top_p", "stop", "stop_checked",
         "embeds", "prefix", "submitted_at", "started_at", "finished_at",
+        "first_token_at", "last_token_at",  # latency spans (TTFT/inter-token)
         "__weakref__",  # the dp router tracks request→replica ownership
     )
 
@@ -295,6 +406,8 @@ class Request:
         self.submitted_at = time.perf_counter()
         self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
+        self.first_token_at: Optional[float] = None
+        self.last_token_at: Optional[float] = None
 
 
 class PrefixHandle:
@@ -337,6 +450,7 @@ class PipelineServer:
         top_p: float = 1.0,
         prefill_chunk: Optional[int] = None,
         pipeline_depth: int = 1,
+        trace_path: Optional[str] = None,
     ):
         self.engine = engine
         self.cfg = engine.cfg
@@ -380,6 +494,11 @@ class PipelineServer:
             raise ValueError("pipeline_depth must be >= 1")
         self.pipeline_depth = pipeline_depth
         self.counters = Counters()
+        # optional JSONL span trace (obs/trace.py). Deliberately NOT part of
+        # serve_kwargs in snapshot(): an observability knob, not serving
+        # state — the checkpoint format is unchanged.
+        self._trace = TraceWriter(trace_path) if trace_path else None
+        _LIVE_SERVERS.add(self)  # load gauges sum over live servers
 
         from ..ops.quant import QTensor
 
@@ -495,7 +614,8 @@ class PipelineServer:
             if top_k > 0 or top_p < 1.0:
                 self._filtering = True
             self._queue.append(req)
-            self.counters.requests_submitted += 1
+            self.counters.inc("requests_submitted")
+            _update_load_gauges()
         logger.info(
             "submit id=%d prompt_len=%d max_new=%d queued=%d",
             req.id, req.prompt_len, max_new_tokens, len(self._queue),
@@ -521,6 +641,9 @@ class PipelineServer:
             )
         buf = np.zeros((1, spx), np.int32)
         buf[0, :n] = prefix
+        record_shape_key(
+            "prefix_prefill", (self.num_stages, spx, self.tp)
+        )
         kv = serve_ops.prefix_prefill(
             self.cfg,
             self.mesh,
@@ -679,6 +802,11 @@ class PipelineServer:
             r.row = d["row"]
             if r.row is not None:
                 r.started_at = time.perf_counter()
+            if r.tokens:
+                # revived mid-decode: its TTFT happened in the previous
+                # process — backfill so the first post-restore token doesn't
+                # record a spurious near-zero TTFT sample
+                r.first_token_at = r.last_token_at = time.perf_counter()
             return r
 
         srv._rows = [req_from(d) for d in snap["rows"]]
@@ -691,7 +819,10 @@ class PipelineServer:
         srv._sampling = snap["sampling"]
         srv._filtering = snap["filtering"]
         srv._ids = itertools.count(snap["next_id"])
-        srv.counters = Counters(**snap["counters"])
+        # from_snapshot, not Counters(**…): a snapshot taken by a build with
+        # different counter fields must keep loading (unknown keys ignored,
+        # missing ones default)
+        srv.counters = Counters.from_snapshot(snap["counters"])
         return srv
 
     def submit_embedding(
@@ -742,7 +873,8 @@ class PipelineServer:
             if top_k > 0 or top_p < 1.0:
                 self._filtering = True
             self._queue.append(req)
-            self.counters.requests_submitted += 1
+            self.counters.inc("requests_submitted")
+            _update_load_gauges()
         logger.info(
             "submit_embedding id=%d prompt_len=%d max_new=%d queued=%d",
             req.id, req.prompt_len, max_new_tokens, len(self._queue),
@@ -757,7 +889,12 @@ class PipelineServer:
         depth 1): while the host blocks on fetching chunk n's few-hundred-
         byte log, the device is already executing chunk n+1 — the tunnel
         round-trip disappears behind compute. Tokens therefore surface one
-        chunk late; ``run_until_idle`` drains the tail."""
+        chunk late; ``run_until_idle`` drains the tail.
+
+        Each phase records its duration under
+        ``server_step_phase_seconds{phase=admit|dispatch|apply}`` — note the
+        dispatch figure is HOST dispatch time (the chunk executes async on
+        device); with ``trace_path=`` the phases also land as JSONL spans."""
         with self._mutex:
             progressed = False
             if self._queue and self._free_slots():
@@ -766,9 +903,20 @@ class PipelineServer:
                 # free slot: under full-slot backlog the flush would block on
                 # the in-flight chunk every step and defeat the pipelining; a
                 # slot freed inside an un-applied log is seen one step later.
+                t0 = time.perf_counter()
                 self._drain(0)
                 progressed |= self._admit_pending()
+                _M_STEP_PHASE.labels(phase="admit").observe(
+                    time.perf_counter() - t0
+                )
             if self._any_active():
+                t0 = time.perf_counter()
+                cycles = self.num_stages * self.chunk_cycles
+                record_shape_key(
+                    "serve_chunk",
+                    (self.num_stages, self.batch_per_slot, self.capacity,
+                     cycles, self._sampling, self._filtering, self.tp),
+                )
                 self.state, log = serve_ops.serve_chunk(
                     self.cfg,
                     self.mesh,
@@ -777,20 +925,36 @@ class PipelineServer:
                     self.engine.head_params,
                     self.state,
                     self.num_stages,
-                    self.num_stages * self.chunk_cycles,
+                    cycles,
                     self._sampling,
                     self._filtering,
                     tp=self.tp,
                 )
                 self._pending.append(
-                    ("chunk", self._prefetcher.fetch(log), self._m)
+                    ("chunk",
+                     self._prefetcher.fetch(log, tag=f"chunk m0={self._m}"),
+                     self._m)
                 )
-                self._m += self.num_stages * self.chunk_cycles
-                self.counters.chunks += 1
+                dt_dispatch = time.perf_counter() - t0
+                _M_STEP_PHASE.labels(phase="dispatch").observe(dt_dispatch)
+                if self._trace:
+                    self._trace.emit(
+                        "chunk", dur_s=dt_dispatch, m0=self._m, cycles=cycles,
+                    )
+                self._m += cycles
+                self.counters.inc("chunks")
                 progressed = True
-                self._drain(self.pipeline_depth)
+                t0 = time.perf_counter()
+                applied = self._drain(self.pipeline_depth)
             else:
-                self._drain(0)
+                t0 = time.perf_counter()
+                applied = self._drain(0)
+            dt_apply = time.perf_counter() - t0
+            if progressed or applied:
+                _M_STEP_PHASE.labels(phase="apply").observe(dt_apply)
+                if self._trace:
+                    self._trace.emit("apply", dur_s=dt_apply, applied=applied)
+                _update_load_gauges()
             return progressed
 
     def run_until_idle(self) -> None:
@@ -798,6 +962,12 @@ class PipelineServer:
         a real deployment calls ``step`` from its own loop forever)."""
         while self._queue or self._any_active() or self._pending:
             self.step()
+
+    def close(self) -> None:
+        """Flush and close the JSONL trace (no-op without ``trace_path``).
+        The server remains usable; further spans are simply dropped."""
+        if self._trace is not None:
+            self._trace.close()
 
     def cancel(self, req: Request) -> bool:
         """Cancel a queued or in-flight request (a capability the reference
@@ -820,7 +990,8 @@ class PipelineServer:
                     return False
                 req.done = True
                 req.finished_at = time.perf_counter()
-                self.counters.requests_cancelled += 1
+                self.counters.inc("requests_cancelled")
+                _update_load_gauges()
                 return True
             if self._rows[req.row] is not req:
                 # not this server's request (dp router broadcast) or the row
@@ -830,7 +1001,8 @@ class PipelineServer:
             req.done = True
             req.finished_at = time.perf_counter()
             self._rows[req.row] = None
-            self.counters.requests_cancelled += 1
+            self.counters.inc("requests_cancelled")
+            _update_load_gauges()
         logger.info("cancel id=%d row=%d tokens=%d", req.id, req.row,
                     len(req.tokens))
         return True
@@ -966,6 +1138,7 @@ class PipelineServer:
         for slot in self._free_slots():
             if not self._queue:
                 break
+            t_admit0 = time.perf_counter()
             Bs = self.batch_per_slot
             # Co-admit only same-bucket requests: submit() validated each
             # request's capacity needs against ITS OWN bucket, and admission
@@ -1016,18 +1189,26 @@ class PipelineServer:
                 topps[i] = r.top_p
                 r.row = slot * Bs + i
                 r.started_at = time.perf_counter()
+                _M_QUEUE_WAIT.observe(r.started_at - r.submitted_at)
                 self._rows[r.row] = r
                 # mirrors track TOTAL (prefix-inclusive) lengths — they
                 # replay the device's absolute-position bookkeeping
                 pfx_n = 0 if pfx is None else pfx.n
                 self._mirror_len[r.row] = pfx_n + r.prompt_len
                 self._mirror_budget[r.row] = pfx_n + r.prompt_len + r.max_new
+            serve_ops.ADMIT_BUCKET_USED.labels(bucket=str(bucket)).inc()
             if not is_emb and pfx is None and self._chunked(bucket):
                 self._admit_chunked(
                     slot, prompts, plen, row_valid, max_new, seeds, temps,
                     topks, topps,
                 )
             else:
+                record_shape_key(
+                    "serve_admit",
+                    (self.num_stages, Bs, self.capacity, bucket, is_emb,
+                     None if pfx is None else pfx.spx, self._filtering,
+                     self.tp),
+                )
                 self.state, tok0 = serve_ops.serve_admit(
                     self.cfg,
                     self.mesh,
@@ -1061,12 +1242,22 @@ class PipelineServer:
                 self._pending.append(
                     (
                         "admit",
-                        self._prefetcher.fetch(tok0),
+                        self._prefetcher.fetch(
+                            tok0,
+                            tag=f"admit slot={slot} "
+                                f"ids={[r.id for r in batch]}",
+                        ),
                         [(r.row, r) for r in batch],
                     )
                 )
-            self.counters.admissions += 1
+            self.counters.inc("admissions")
             admitted = True
+            if self._trace:
+                self._trace.emit(
+                    "admit", dur_s=time.perf_counter() - t_admit0, slot=slot,
+                    ids=[r.id for r in batch], bucket=bucket,
+                    chunked=self._chunked(bucket), n=len(batch),
+                )
             logger.info(
                 "admit slot=%d ids=%s bucket=%d chunked=%s in_flight=%d",
                 slot, [r.id for r in batch], bucket, self._chunked(bucket),
@@ -1094,6 +1285,10 @@ class PipelineServer:
         positions = np.where(idx < plen[:, None], idx, serve_ops.POS_SENTINEL)
         # mask each row's final real token — processed via injection instead
         positions[np.arange(Bs), np.maximum(plen - 1, 0)] = serve_ops.POS_SENTINEL
+        record_shape_key(
+            "serve_prefill_chunk",
+            (self.num_stages, Bs, self.capacity, Sc, self.tp),
+        )
         for ci, off in enumerate(range(0, bucket, Sc)):
             self.state = serve_ops.serve_prefill_chunk(
                 self.cfg,
@@ -1114,6 +1309,12 @@ class PipelineServer:
             # admitting rows themselves are in _rows already and must not
             # count, or an idle server would pay a useless cycle per chunk
             if self._any_active(exclude=frozenset(self._admitting_rows)):
+                record_shape_key(
+                    "serve_chunk",
+                    (self.num_stages, self.batch_per_slot, self.capacity,
+                     self.num_stages, self._sampling, self._filtering,
+                     self.tp),
+                )
                 self.state, log = serve_ops.serve_chunk(
                     self.cfg,
                     self.mesh,
@@ -1128,12 +1329,18 @@ class PipelineServer:
                     tp=self.tp,
                 )
                 self._pending.append(
-                    ("chunk", self._prefetcher.fetch(log), self._m)
+                    ("chunk",
+                     self._prefetcher.fetch(log, tag=f"chunk m0={self._m}"),
+                     self._m)
                 )
                 self._m += self.num_stages
-                self.counters.chunks += 1
+                self.counters.inc("chunks")
                 self._drain(self.pipeline_depth)
         last_tok = prompts[np.arange(Bs), np.maximum(plen - 1, 0)]
+        record_shape_key(
+            "serve_admit_finish",
+            (self.num_stages, Bs, self.capacity, self.tp),
+        )
         self.state = serve_ops.serve_admit_finish(
             self.cfg,
             self.mesh,
@@ -1153,14 +1360,16 @@ class PipelineServer:
         )
         self._admitting_rows.difference_update(range(row0, row0 + Bs))
 
-    def _drain(self, max_pending: int) -> None:
+    def _drain(self, max_pending: int) -> int:
         """Apply queued device reads until at most ``max_pending`` remain.
         ``max_pending=1`` is the steady-state pipeline depth (the newest
         chunk's log stays in flight while its chunk executes);
         ``max_pending=0`` is a full flush (before admission decisions and at
-        drain time)."""
+        drain time). Returns the number of entries applied."""
+        applied = 0
         while len(self._pending) > max_pending:
             entry = self._pending.popleft()
+            applied += 1
             if entry[0] == "chunk":
                 self._apply_log(entry[1].get(), entry[2])
             else:  # "admit": per-row first tokens from serve_admit
@@ -1169,6 +1378,7 @@ class PipelineServer:
                     if req.done or self._rows[row] is not req:
                         continue  # cancelled between dispatch and drain
                     self._apply_token(row, req, int(tok0[i]))
+        return applied
 
     def _apply_log(self, log: np.ndarray, m0: int) -> None:
         """Replay one chunk's token log into the host mirrors. At microstep
@@ -1190,9 +1400,19 @@ class PipelineServer:
                 self._apply_token(row, req, t)
 
     def _apply_token(self, row: int, req: Request, t: int) -> None:
-        """One committed token → request buffer + mirrors + completion."""
+        """One committed token → request buffer + mirrors + completion,
+        recording the request's latency spans (TTFT on the first token,
+        inter-arrival on every subsequent one, queue-wait + e2e + tok/s at
+        completion) into the metrics registry."""
         req.tokens.append(t)
-        self.counters.tokens_generated += 1
+        now = time.perf_counter()
+        if req.first_token_at is None:
+            req.first_token_at = now
+            _M_TTFT.observe(now - req.submitted_at)
+        else:
+            _M_INTERTOKEN.observe(now - req.last_token_at)
+        req.last_token_at = now
+        self.counters.inc("tokens_generated")
         self._mirror_len[row] += 1
         finished = (
             t in self._stop_ids
@@ -1207,10 +1427,31 @@ class PipelineServer:
             req.done = True
             req.finished_at = time.perf_counter()
             self._rows[row] = None  # slot row becomes reusable
-            self.counters.requests_completed += 1
+            self.counters.inc("requests_completed")
             dur = req.finished_at - (req.started_at or req.finished_at)
+            queue_wait = (
+                (req.started_at - req.submitted_at)
+                if req.started_at is not None else 0.0
+            )
+            ttft = (
+                (req.first_token_at - req.submitted_at)
+                if req.first_token_at is not None else 0.0
+            )
             ntok = len(req.tokens)
+            # dur == 0 (or an unset started_at) reports 0.0, not inf — a
+            # rate measured over no window is no rate
+            tok_s = ntok / dur if dur > 0 else 0.0
+            _M_REQUEST.observe(req.finished_at - req.submitted_at)
+            _M_TOK_S.observe(tok_s)
+            if self._trace:
+                self._trace.emit(
+                    "request", dur_s=req.finished_at - req.submitted_at,
+                    id=req.id, tokens=ntok,
+                    queue_wait_s=round(queue_wait, 6),
+                    ttft_s=round(ttft, 6), tok_s=round(tok_s, 2),
+                )
             logger.info(
-                "complete id=%d tokens=%d duration=%.3fs tok/s=%.1f",
-                req.id, ntok, dur, ntok / dur if dur > 0 else float("inf"),
+                "complete id=%d tokens=%d duration=%.3fs queue_wait=%.3fs "
+                "tok/s=%.1f",
+                req.id, ntok, dur, queue_wait, tok_s,
             )

@@ -8,6 +8,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from llm_sharding_tpu._compat import shard_map
 from llm_sharding_tpu.models import llama
 from llm_sharding_tpu.models.cache import POS_SENTINEL, init_cache
 from llm_sharding_tpu.models.config import tiny_llama
@@ -37,7 +38,7 @@ def test_ring_attention_matches_dense():
 
     mesh = context_mesh(n_dev)
     got = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda q, k, v, qp, kp: ring_attention(q, k, v, qp, kp, SEQ_AXIS),
             mesh=mesh,
             in_specs=(P(None, SEQ_AXIS), P(None, SEQ_AXIS), P(None, SEQ_AXIS),
@@ -63,7 +64,7 @@ def test_ring_attention_with_padding():
     want = _reference_attention(q, k, v, pos, pos)
     mesh = context_mesh(4)
     got = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda q, k, v, qp, kp: ring_attention(q, k, v, qp, kp, SEQ_AXIS),
             mesh=mesh,
             in_specs=(P(None, SEQ_AXIS),) * 3 + (P(None, SEQ_AXIS),) * 2,
