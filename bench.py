@@ -423,6 +423,79 @@ def bench_prefix_cache(on_tpu, engine):
     )
 
 
+def bench_spec(on_tpu, cfg, params, jax, jnp):
+    """Speculative decoding (n-gram self-drafting, runtime/spec.py) on a
+    LOOKUP-FRIENDLY workload: the prompt is self-primed — the model's own
+    greedy continuation is appended to a random prompt, so the decode window
+    extends text whose n-grams recur in the prompt (the shape real spec
+    workloads have: code, retrieved context, chat history echoes). Both
+    paths decode the SAME primed prompt; greedy spec output is token-
+    identical to the baseline by construction, so the ratio is pure
+    throughput. spec_burst amortizes the host round trip over several
+    verify steps (drafts are hints — a wrong optimistic guess costs one
+    plain decode step, never correctness), which matters on the tunneled
+    chip where a synchronous fetch costs ~36 ms. Emits the spec tok/s (with
+    the matching non-spec tok/s and the speedup alongside) plus the
+    measured draft acceptance rate as its own metric line."""
+    from llm_sharding_tpu.runtime.generate import generate
+    from llm_sharding_tpu.runtime.spec import M_SPEC_ACCEPTED, M_SPEC_DRAFTED
+
+    name = (
+        "spec_decode_tok_s_llama3.2-3b_1chip" if on_tpu
+        else "spec_decode_tok_s_tiny_cpu"
+    )
+    aname = (
+        "spec_acceptance_rate_llama3.2-3b_1chip" if on_tpu
+        else "spec_acceptance_rate_tiny_cpu"
+    )
+    if on_tpu:
+        # burst=16: on the tunneled chip the batched log fetch (~36 ms)
+        # amortizes over 16 verify steps; a wrong optimistic guess costs a
+        # plain decode step, so deep bursts are ~free in the worst case
+        prompt_len, prime, max_new, K, burst = 32, 96, 256, 8, 16
+    else:
+        prompt_len, prime, max_new, K, burst = 8, 24, 16, 4, 2
+    rng = np.random.default_rng(11)
+    p = rng.integers(0, cfg.vocab_size, prompt_len).astype(np.int32)
+    res = generate(cfg, params, p, prime, capacity=prompt_len + prime)
+    primed = np.asarray(
+        res.tokens[0][: int(res.lengths[0])], np.int32
+    )
+    cap = primed.shape[0] + max_new
+    spec_kw = dict(
+        capacity=cap, speculate=K, spec_ngram=4, spec_burst=burst
+    )
+    generate(cfg, params, primed, max_new, capacity=cap)  # warm base
+    generate(cfg, params, primed, max_new, **spec_kw)     # warm spec
+    d0, a0 = M_SPEC_DRAFTED.value, M_SPEC_ACCEPTED.value
+    base = spec = 0.0
+    for _ in range(3):  # best-of: tunnel jitter (see time_decode)
+        t0 = time.perf_counter()
+        r = generate(cfg, params, primed, max_new, capacity=cap)
+        dt = time.perf_counter() - t0
+        n = int(np.sum(r.lengths)) - primed.shape[0]
+        base = max(base, n / dt)
+        t0 = time.perf_counter()
+        r = generate(cfg, params, primed, max_new, **spec_kw)
+        dt = time.perf_counter() - t0
+        n = int(np.sum(r.lengths)) - primed.shape[0]
+        spec = max(spec, n / dt)
+    drafted = M_SPEC_DRAFTED.value - d0
+    accepted = M_SPEC_ACCEPTED.value - a0
+    rate = accepted / drafted if drafted else 0.0
+    emit(
+        name, spec, "tokens/sec", spec / ANCHOR_TOK_S,
+        base_tok_s=round(base, 2),
+        speedup_vs_nonspec=round(spec / base, 3) if base else 0.0,
+        speculate=K, burst=burst, max_new=max_new,
+        prompt_len=int(primed.shape[0]),
+    )
+    emit(
+        aname, rate, "fraction_drafts_accepted", rate,
+        drafted=int(drafted), accepted=int(accepted),
+    )
+
+
 def bench_hop_latency(on_tpu, jax, jnp):
     """p50 inter-stage hidden-state hop latency — BASELINE.md's north-star
     secondary metric. One chip → the ppermute is a LOOPBACK (self-edge) and
@@ -579,6 +652,10 @@ def main():
         "decode_tok_s_llama3.2-3b-int4_1chip" if on_tpu
         else "decode_tok_s_tiny-int4_cpu"
     )
+    nspec = (
+        "spec_decode_tok_s_llama3.2-3b_1chip" if on_tpu
+        else "spec_decode_tok_s_tiny_cpu"
+    )
     nserve8 = (
         "serve_tok_s_llama3.2-3b-int8_1stage" if on_tpu
         else "serve_tok_s_tiny-int8_cpu"
@@ -632,6 +709,16 @@ def main():
                 emit_error(nprefix, "x_speedup_vs_full_prefill", e)
         del serve_engine
         gc.collect()
+        # speculative decode BEFORE int8: it reuses the live bf16 device
+        # params (the donating quantization below consumes them)
+        if remaining() < 150:
+            emit_skip(nspec, "tokens/sec", 150)
+        else:
+            try:
+                bench_spec(on_tpu, cfg3b, params3b, jax, jnp)
+            except Exception as e:  # noqa: BLE001
+                emit_error(nspec, "tokens/sec", e)
+            gc.collect()
         # int8 AFTER serve: the donating quantization consumes the bf16
         # buffers the serve engine was aliasing
         if remaining() < 120:
@@ -682,6 +769,7 @@ def main():
         emit_error(nserve, "tokens/sec", "not attempted: 3B section failed")
         emit_error(nprefix, "x_speedup_vs_full_prefill",
                    "not attempted: 3B section failed")
+        emit_error(nspec, "tokens/sec", "not attempted: 3B section failed")
         emit_error(n4, "tokens/sec", "not attempted: 3B section failed")
         emit_error(nserve8, "tokens/sec", "not attempted: 3B section failed")
 

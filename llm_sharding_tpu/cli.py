@@ -216,6 +216,8 @@ def _serve_control(eng, srv, line: str, args):
                 top_k=args.top_k,
                 top_p=args.top_p,
                 trace_path=getattr(args, "trace_path", None),
+                speculate=getattr(args, "speculate", 0),
+                spec_ngram=getattr(args, "spec_ngram", 3),
             )
 
         try:
@@ -298,6 +300,8 @@ def cmd_serve(args) -> int:
             top_k=args.top_k,
             top_p=args.top_p,
             trace_path=args.trace_path,
+            speculate=args.speculate,
+            spec_ngram=args.spec_ngram,
         )
         eng = srv.engines[0]
         print(
@@ -328,6 +332,33 @@ def cmd_serve(args) -> int:
                 f"{len(revived)} live request(s) resume",
                 file=sys.stderr,
             )
+            # the snapshot's serve_kwargs win over the CLI serve flags —
+            # say so explicitly instead of silently ignoring them (the old
+            # banner printed the CLI --capacity while the daemon actually
+            # ran at the snapshot's; ADVICE r5)
+            ignored = [
+                f"--{flag.replace('_', '-')} {got} (snapshot: {used})"
+                for flag, got, used in (
+                    ("capacity", args.capacity, srv.capacity),
+                    ("batch_per_slot", args.batch_per_slot,
+                     srv.batch_per_slot),
+                    ("prefill_chunk", args.prefill_chunk, srv.prefill_chunk),
+                    ("top_k", args.top_k, srv.top_k),
+                    ("top_p", args.top_p, srv.top_p),
+                    ("speculate", getattr(args, "speculate", 0),
+                     srv.speculate),
+                    ("spec_ngram", getattr(args, "spec_ngram", 3),
+                     srv.spec_ngram),
+                )
+                if got != used
+            ]
+            if ignored:
+                print(
+                    "warning: serve flags differ from the snapshot and are "
+                    "ignored (a restored daemon keeps its snapshot's "
+                    "serve_kwargs): " + ", ".join(ignored),
+                    file=sys.stderr,
+                )
             if revived:
                 # finish the snapshot's requests first; their clients are
                 # gone, so the completed text goes to stdout one per line
@@ -344,10 +375,15 @@ def cmd_serve(args) -> int:
                 top_k=args.top_k,
                 top_p=args.top_p,
                 trace_path=args.trace_path,
+                speculate=args.speculate,
+                spec_ngram=args.spec_ngram,
             )
+        # srv.capacity, not args.capacity: after --restore the daemon runs
+        # at the SNAPSHOT's serve_kwargs (ADVICE r5 — the banner used to
+        # claim the CLI value)
         print(
             f"serving {eng.cfg.model_type} over {eng.mesh.shape} "
-            f"(capacity={args.capacity}); enter a prompt, ^D to exit; "
+            f"(capacity={srv.capacity}); enter a prompt, ^D to exit; "
             f":placement <ranges|N> re-shards live",
             file=sys.stderr,
         )
@@ -731,6 +767,19 @@ def build_parser() -> argparse.ArgumentParser:
         "--prefill-chunk", type=int, default=None, dest="prefill_chunk",
         help="prefill prompts longer than this in bounded chunks so live "
         "streams keep producing during admission (power of two)",
+    )
+    s.add_argument(
+        "--speculate", type=int, default=0,
+        help="speculative decoding: draft up to K tokens per row by n-gram "
+        "lookup over the request's own ids and verify K+1 positions per "
+        "forward — greedy output is token-identical, decode tok/s rises "
+        "with the workload's self-repetition (0 = off; incompatible with "
+        "--prefill-chunk)",
+    )
+    s.add_argument(
+        "--spec-ngram", type=int, default=3, dest="spec_ngram",
+        help="longest n-gram the drafter matches against the request's "
+        "prompt+generation suffix (with --speculate)",
     )
     s.add_argument("--dtype", default="bf16")
     s.add_argument("--temperature", type=float, default=0.0)

@@ -45,8 +45,8 @@ from ..models.config import ModelConfig
 from ..obs.metrics import REGISTRY
 from ..ops.sampling import is_stop as _is_stop
 from .head import (
-    head_specs, key_chain_split, local_view, psum_from, seed_chain_init,
-    sp_embed, sp_next_token, sp_sample_rows,
+    _local_logits, head_specs, key_chain_split, local_view, psum_from,
+    seed_chain_init, sp_embed, sp_next_token, sp_sample_rows,
 )
 from .mesh import PIPE_AXIS
 from .pipeline import model_fns, ring_chain, stage_layer_specs
@@ -414,7 +414,15 @@ def serve_admit(
         rows = row0 + jnp.arange(Bs, dtype=jnp.int32)
         out_rows = jnp.zeros((Bs, C), jnp.int32)
         out_rows = jax.lax.dynamic_update_slice(out_rows, prompts, (0, 0))
-        out_rows = out_rows.at[jnp.arange(Bs), prompt_len].set(tok0)
+        # ``out`` column == PREFIX-INCLUSIVE sequence index for everything a
+        # row generates (``serve_chunk`` commits at wpos = lengths, which
+        # counts the prefix): tok0 must land at column ``total``, not the
+        # suffix-relative ``prompt_len`` — a prefix admission previously left
+        # an n-column gap between tok0 and the chunk commits (ADVICE r5).
+        # For prefix rows, columns [prompt_len, total) stay zero (the prefix
+        # ids live in the handle, not in ``out``); the generated run is
+        # contiguous from column ``total`` on.
+        out_rows = out_rows.at[jnp.arange(Bs), total].set(tok0)
         out = jax.lax.dynamic_update_slice_in_dim(st.out, out_rows, row0, axis=0)
 
         lengths = jax.lax.dynamic_update_slice_in_dim(
@@ -910,3 +918,272 @@ def serve_chunk(
         out_specs=(specs, P()),
         check_vma=False,
     )(stage_layers, layer_masks, head_params, state)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "mesh", "num_stages", "K", "sampling", "filtering", "tp",
+    ),
+    donate_argnums=(5,),  # see serve_admit
+)
+def serve_verify(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    stage_layers: Any,
+    layer_masks: jnp.ndarray,
+    head_params: Any,  # vocab-sharded
+    state: ServeState,
+    draft: jnp.ndarray,      # [Bs, K] right-padded n-gram draft ids
+    draft_len: jnp.ndarray,  # [Bs] valid draft tokens per row
+    slot: jnp.ndarray,       # scalar int32
+    cache_delta: jnp.ndarray,  # [Bs] per-row constant (cache slot − token
+    #   position), fixed at admission: bucket padding [+ padded-prefix
+    #   columns − real prefix length]. The canonical slot of the pending
+    #   token's KV is pos + delta — per-row because speculative acceptance
+    #   diverges row from row, unlike the per-slot write_off microsteps use
+    num_stages: int,
+    K: int,
+    sampling: bool = False,
+    filtering: bool = True,
+    tp: int = 1,
+):
+    """Speculative verify for one slot: ONE parked-pipeline ring traversal
+    over the K+1 draft positions per row — a tiny prefill (the ``serve_admit``
+    machinery) that also reads logits at EVERY position — committing a
+    VARIABLE number of tokens per row. Returns ``(state, log)`` with ``log``
+    ``[Bs, K+1]`` int32: the committed run per row, -1 padded — the host's
+    only read (it feeds the next draft and replays the mirrors exactly like
+    a chunk log).
+
+    Greedy rows accept by exact leading match against the model's argmax
+    choices, so a speculative server is token-identical to a chunked one —
+    drafts only set how many tokens commit per weight pass. Sampled rows
+    (temperature > 0) use rejection acceptance against the point-mass draft:
+    accept d with probability p(d) under the row's filtered target, else
+    resample from the target with d masked — the committed stream keeps the
+    target distribution. The sampled path gathers the full [rows*(K+1), V]
+    distribution on every stage (like ``sp_sample_rows``'s filtering path);
+    greedy stays shard-local.
+
+    KV rollback: the traversal writes its K+1 entries into the SCRATCH
+    columns at the top of the cache (the server allocates ``K+1`` columns
+    over its usable capacity); the accepted prefix is then compacted to each
+    row's canonical columns at ``cache_off`` and the scratch key positions
+    rewound to the sentinel — rejected positions are logically discarded
+    (never attended) without copying live state. ``pos_slots``/``lengths``/
+    ``done``/``out``/``rng`` update exactly as if the committed tokens had
+    arrived one microstep at a time, so snapshots taken between steps stay
+    restore-compatible."""
+    # the shard-agnostic verify math (leading-match acceptance, rejection
+    # commit assembly, EOS/budget capping) lives in runtime/spec.py — ONE
+    # definition shared with the monolith verify, so the two decode paths
+    # cannot silently diverge (lazy import: parallel must not pull the
+    # runtime package at module load)
+    from ..runtime.spec import _leading_true_count, cap_commits, rejection_commit
+
+    fns = model_fns(cfg, tp_axis=TENSOR_AXIS if tp > 1 else None)
+    Bs = draft.shape[0]
+    ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+    C_total = state.out.shape[1]
+    scratch = C_total - (K + 1)
+
+    def body(stage_layers, layer_mask, head_params, state, draft, draft_len,
+             slot, cache_delta):
+        layers = jax.tree.map(lambda a: a[0], stage_layers)
+        lmask = layer_mask[0]
+        hd = local_view(head_params)
+        sidx = jax.lax.axis_index(PIPE_AXIS)
+        st = jax.tree.map(
+            lambda spec, leaf: leaf[0] if _dev(spec) else leaf,
+            state_specs(state, tp), state,
+        )
+        row0 = slot * Bs
+        rows = row0 + jnp.arange(Bs, dtype=jnp.int32)
+        iota = jnp.arange(K + 1, dtype=jnp.int32)
+
+        pos_rows = jax.lax.dynamic_slice_in_dim(st.pos_slots, row0, Bs)
+        cache_off = pos_rows + cache_delta  # pending token's canonical slot
+        done_rows = jax.lax.dynamic_slice_in_dim(st.done, row0, Bs)
+        len_rows = jax.lax.dynamic_slice_in_dim(st.lengths, row0, Bs)
+        bud_rows = jax.lax.dynamic_slice_in_dim(st.budget, row0, Bs)
+        out_rows = jax.lax.dynamic_slice_in_dim(st.out, row0, Bs, axis=0)
+        # pending token = the last committed one (its KV is not yet written;
+        # out column == prefix-inclusive sequence index == lengths - 1)
+        tok_pend = jnp.take_along_axis(
+            out_rows, jnp.clip(len_rows - 1, 0, C_total - 1)[:, None], axis=1
+        )[:, 0]
+
+        cache = KVCache(
+            k=jax.lax.dynamic_slice_in_dim(st.k, row0, Bs, axis=1),
+            v=jax.lax.dynamic_slice_in_dim(st.v, row0, Bs, axis=1),
+            pos=jax.lax.dynamic_slice_in_dim(st.kpos, row0, Bs, axis=0),
+            length=jnp.asarray(scratch, jnp.int32),
+        )
+        toks_in = jnp.concatenate([tok_pend[:, None], draft], axis=1)
+        positions = jnp.where(
+            done_rows[:, None], POS_SENTINEL,
+            pos_rows[:, None] + iota[None, :],
+        )
+        h = sp_embed(cfg, hd, toks_in, positions)
+        h, cache = ring_chain(
+            fns, cfg, layers, lmask, sidx, ring, num_stages, h, cache,
+            positions,
+        )
+        # final-depth hidden for ALL K+1 positions, replicated from stage 0
+        # (the block lands back on its origin after the full ring trip)
+        hf = psum_from(h.reshape(Bs * (K + 1), -1), 0)
+
+        valid_draft = iota[None, :K] < draft_len[:, None]  # [Bs, K]
+        choices = sp_next_token(cfg, hd, hf).reshape(Bs, K + 1)
+        match = (choices[:, :K] == draft) & valid_draft
+        a = _leading_true_count(match)
+        commit = choices
+
+        if sampling:
+            temp_rows = jax.lax.dynamic_slice_in_dim(st.temp, row0, Bs)
+            topk_rows = jax.lax.dynamic_slice_in_dim(st.topk, row0, Bs)
+            topp_rows = jax.lax.dynamic_slice_in_dim(st.topp, row0, Bs)
+            rng_rows = jax.lax.dynamic_slice_in_dim(st.rng, row0, Bs, axis=0)
+            new_keys, subs = key_chain_split(rng_rows)
+            logits_loc, _lo = _local_logits(cfg, hd, hf)  # [Bs*(K+1), Vs]
+            allv = jax.lax.all_gather(logits_loc, PIPE_AXIS)  # [S, N, Vs]
+            full = jnp.transpose(allv, (1, 0, 2)).reshape(allv.shape[1], -1)
+            Vp = full.shape[-1]
+            full = full.reshape(Bs, K + 1, Vp)
+            safe_t = jnp.where(temp_rows > 0, temp_rows, 1.0)
+            scaled = full / safe_t[:, None, None]
+            if filtering:
+                from ..ops.sampling import top_p_threshold
+
+                desc = -jnp.sort(-scaled, axis=-1)  # [Bs, K+1, Vp]
+                k_idx = jnp.clip(topk_rows - 1, 0, Vp - 1)
+                kth = jnp.take_along_axis(
+                    desc, k_idx[:, None, None], axis=-1
+                )
+                kth = jnp.where(
+                    (topk_rows > 0)[:, None, None], kth, -jnp.inf
+                )
+                desc_k = jnp.where(desc < kth, -jnp.inf, desc)
+                pth = top_p_threshold(
+                    desc_k.reshape(Bs * (K + 1), Vp),
+                    jnp.repeat(topp_rows, K + 1),
+                    presorted=True,
+                ).reshape(Bs, K + 1, 1)
+                pth = jnp.where(
+                    (topp_rows < 1.0)[:, None, None], pth, -jnp.inf
+                )
+                scaled = jnp.where(
+                    scaled < jnp.maximum(kth, pth), -jnp.inf, scaled
+                )
+            # per-(row, position) draws off the row chain: one chain split
+            # per verify step (replicated keys -> identical on every stage)
+            def pos_draws(kd):
+                ku, kg = jax.random.split(jax.random.wrap_key_data(kd))
+                u = jax.random.uniform(ku, (K,))
+                g = jax.random.gumbel(kg, (K + 1, Vp), jnp.float32)
+                return u, g
+
+            u, g = jax.vmap(pos_draws)(subs)
+            a_s, commit_s = rejection_commit(scaled, draft, valid_draft, u, g)
+            is_samp = temp_rows > 0
+            a = jnp.where(is_samp, a_s, a)
+            commit = jnp.where(is_samp[:, None], commit_s, commit)
+
+        # ---- cap the run: EOS inside it, per-row budget, done rows ----
+        c, log, eos_hit = cap_commits(
+            cfg, commit, a, bud_rows - len_rows, done_rows
+        )
+        new_len = len_rows + c
+        new_done = done_rows | eos_hit | ((c > 0) & (new_len >= bud_rows))
+
+        # ---- out: the committed run lands at columns len .. len+c-1 ----
+        colidx = jnp.arange(C_total, dtype=jnp.int32)[None, :]
+        rel = colidx - len_rows[:, None]
+        in_run = (rel >= 0) & (rel < c[:, None])
+        vals = jnp.take_along_axis(commit, jnp.clip(rel, 0, K), axis=1)
+        out_rows = jnp.where(in_run, vals, out_rows)
+
+        # ---- KV rollback/compaction (see docstring) ----
+        chunk_k = jax.lax.dynamic_slice_in_dim(
+            cache.k, scratch, K + 1, axis=2
+        )
+        chunk_v = jax.lax.dynamic_slice_in_dim(
+            cache.v, scratch, K + 1, axis=2
+        )
+
+        def compact(row_kv, row_chunk, start):
+            return jax.lax.dynamic_update_slice(
+                row_kv, row_chunk, (0, start, 0, 0)
+            )
+
+        k_slot = jax.vmap(compact, in_axes=(1, 1, 0), out_axes=1)(
+            cache.k, chunk_k, cache_off
+        )
+        v_slot = jax.vmap(compact, in_axes=(1, 1, 0), out_axes=1)(
+            cache.v, chunk_v, cache_off
+        )
+        row_pos = jnp.where(
+            iota[None, :] < c[:, None], pos_rows[:, None] + iota[None, :],
+            POS_SENTINEL,
+        ).astype(jnp.int32)
+        pos_slot = jax.vmap(
+            lambda p_row, vals_row, start: jax.lax.dynamic_update_slice(
+                p_row, vals_row, (start,)
+            )
+        )(cache.pos, row_pos, cache_off)
+        pos_slot = jax.lax.dynamic_update_slice(
+            pos_slot,
+            jnp.full((Bs, K + 1), POS_SENTINEL, jnp.int32),
+            (0, scratch),
+        )
+
+        if sampling:
+            rng_new = jnp.where((c > 0)[:, None], new_keys, rng_rows)
+        inject_pending = st.inject_pending.at[rows].set(False)
+
+        new = st._replace(
+            k=jax.lax.dynamic_update_slice_in_dim(st.k, k_slot, row0, axis=1),
+            v=jax.lax.dynamic_update_slice_in_dim(st.v, v_slot, row0, axis=1),
+            kpos=jax.lax.dynamic_update_slice_in_dim(
+                st.kpos, pos_slot, row0, axis=0
+            ),
+            pos_slots=jax.lax.dynamic_update_slice_in_dim(
+                st.pos_slots, pos_rows + c, row0, axis=0
+            ),
+            out=jax.lax.dynamic_update_slice_in_dim(
+                st.out, out_rows, row0, axis=0
+            ),
+            lengths=jax.lax.dynamic_update_slice_in_dim(
+                st.lengths, new_len, row0, axis=0
+            ),
+            done=jax.lax.dynamic_update_slice_in_dim(
+                st.done, new_done, row0, axis=0
+            ),
+            inject_pending=inject_pending,
+            rng=(
+                jax.lax.dynamic_update_slice_in_dim(
+                    st.rng, rng_new, row0, axis=0
+                )
+                if sampling else st.rng
+            ),
+        )
+        new = jax.tree.map(
+            lambda spec, leaf: leaf[None] if _dev(spec) else leaf,
+            state_specs(state, tp), new,
+        )
+        return new, log
+
+    specs = state_specs(ServeState(*([None] * len(ServeState._fields))), tp)
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            stage_layer_specs(cfg, tp, stage_layers), P(PIPE_AXIS),
+            head_specs(head_params), specs,
+            P(), P(), P(), P(),
+        ),
+        out_specs=(specs, P()),
+        check_vma=False,
+    )(stage_layers, layer_masks, head_params, state, draft, draft_len,
+      slot, cache_delta)

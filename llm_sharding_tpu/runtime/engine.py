@@ -464,27 +464,10 @@ class PipelineEngine:
         out_ids = res.tokens[0, ids.shape[1] : int(res.lengths[0])]
         return tok.decode(out_ids, skip_special_tokens=True)
 
-    def serve(
-        self,
-        *,
-        capacity: int = 1024,
-        batch_per_slot: int = 1,
-        chunk_cycles: int = 1,
-        top_k: int = 0,
-        top_p: float = 1.0,
-        prefill_chunk: Optional[int] = None,
-        pipeline_depth: int = 1,
-        trace_path: Optional[str] = None,
-    ):
-        """Build a continuous-batching server over this engine's sharded
-        arrays (≙ the reference's persistent ``run_worker_loop`` daemon,
-        ``node_worker.py:493-559``). See ``runtime/server.py``.
-
-        Composes with tensor parallelism: a pp×tp engine serves with
-        megatron-sharded stage fns and a heads-sharded KV state (the serve
-        programs take ``tp``). In-program data parallelism does not — use
-        ``runtime.replicated.ReplicatedServer`` (which itself forwards
-        ``tensor_parallel``, so dp×pp×tp serving is replica × this)."""
+    def _validate_serve(self) -> None:
+        """Engine-capability guards for continuous batching — shared by
+        ``serve()`` and ``PipelineServer.restore`` (ADVICE r5: restore used
+        to bypass these and die later with an obscure mesh error)."""
         if self.data_parallel > 1:
             raise NotImplementedError(
                 "serve on an in-program dp engine: use "
@@ -501,6 +484,37 @@ class PipelineEngine:
                 "pipeline_generate — its serve-side permutation is not "
                 "implemented"
             )
+
+    def serve(
+        self,
+        *,
+        capacity: int = 1024,
+        batch_per_slot: int = 1,
+        chunk_cycles: int = 1,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        prefill_chunk: Optional[int] = None,
+        pipeline_depth: int = 1,
+        trace_path: Optional[str] = None,
+        speculate: int = 0,
+        spec_ngram: int = 3,
+    ):
+        """Build a continuous-batching server over this engine's sharded
+        arrays (≙ the reference's persistent ``run_worker_loop`` daemon,
+        ``node_worker.py:493-559``). See ``runtime/server.py``.
+
+        Composes with tensor parallelism: a pp×tp engine serves with
+        megatron-sharded stage fns and a heads-sharded KV state (the serve
+        programs take ``tp``). In-program data parallelism does not — use
+        ``runtime.replicated.ReplicatedServer`` (which itself forwards
+        ``tensor_parallel``, so dp×pp×tp serving is replica × this).
+
+        ``speculate=K`` turns on speculative decoding: n-gram self-drafted
+        tokens verified K+1 positions per forward, a variable number of
+        tokens committed per row per step (``runtime/spec.py``). Greedy
+        output stays token-identical; decode tok/s rises with the workload's
+        n-gram predictability."""
+        self._validate_serve()
         from .server import PipelineServer
 
         return PipelineServer(
@@ -513,6 +527,8 @@ class PipelineEngine:
             prefill_chunk=prefill_chunk,
             pipeline_depth=pipeline_depth,
             trace_path=trace_path,
+            speculate=speculate,
+            spec_ngram=spec_ngram,
         )
 
     def _shared_server(self, prompt_len: int, max_new: int):

@@ -290,8 +290,29 @@ def generate(
     top_p: float = 1.0,
     seed: int = 0,
     cache_dtype=jnp.bfloat16,
+    speculate: int = 0,
+    spec_ngram: int = 3,
+    spec_burst: int = 4,
 ) -> GenerateResult:
-    """End-to-end generation in one compiled program."""
+    """End-to-end generation in one compiled program.
+
+    ``speculate=K`` (K >= 1) switches to speculative decoding: n-gram
+    self-drafted tokens verified K+1 at a time per forward pass
+    (``runtime/spec.py``). Greedy output is token-identical to the default
+    path; ``speculate=0`` is exactly the default path. ``spec_ngram`` sets
+    the longest suffix the drafter matches; ``spec_burst`` the number of
+    optimistically-drafted verify steps dispatched per host round trip."""
+    if speculate:
+        from .spec import spec_generate
+
+        return spec_generate(
+            cfg, params, prompt_ids, max_new_tokens,
+            speculate=speculate, spec_ngram=spec_ngram,
+            spec_burst=spec_burst,
+            prompt_len=prompt_len, capacity=capacity,
+            temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
+            cache_dtype=cache_dtype,
+        )
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
     if prompt_ids.ndim == 1:
         prompt_ids = prompt_ids[None]

@@ -40,7 +40,6 @@ from __future__ import annotations
 
 import collections
 import dataclasses
-import itertools
 import logging
 import queue
 import threading
@@ -370,6 +369,7 @@ class Request:
         "temperature", "seed", "top_k", "top_p", "stop", "stop_checked",
         "embeds", "prefix", "submitted_at", "started_at", "finished_at",
         "first_token_at", "last_token_at",  # latency spans (TTFT/inter-token)
+        "spec_k",  # per-request adaptive draft-width controller (spec mode)
         "__weakref__",  # the dp router tracks request→replica ownership
     )
 
@@ -403,6 +403,7 @@ class Request:
         self.tokens: list[int] = []  # generated ids (incl. EOS if produced)
         self.done = False
         self.row: Optional[int] = None
+        self.spec_k = None  # set by a speculative server at submit
         self.submitted_at = time.perf_counter()
         self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
@@ -451,6 +452,8 @@ class PipelineServer:
         prefill_chunk: Optional[int] = None,
         pipeline_depth: int = 1,
         trace_path: Optional[str] = None,
+        speculate: int = 0,
+        spec_ngram: int = 3,
     ):
         self.engine = engine
         self.cfg = engine.cfg
@@ -493,6 +496,24 @@ class PipelineServer:
         if pipeline_depth < 1:
             raise ValueError("pipeline_depth must be >= 1")
         self.pipeline_depth = pipeline_depth
+        # Speculative decoding (runtime/spec.py + parallel/serve.serve_verify):
+        # speculate=K replaces the interleaved serve_chunk decode with
+        # per-slot verify traversals — the host n-gram-drafts up to K tokens
+        # per row, one forward verifies all K+1 positions, and a VARIABLE
+        # number of tokens commits per row per step. Greedy stays
+        # token-identical to chunk mode. Incompatible with prefill_chunk:
+        # chunked admission interleaves serve_chunk microstep cycles, whose
+        # per-slot write_off bookkeeping a spec server does not maintain.
+        if speculate < 0:
+            raise ValueError(f"speculate must be >= 0, got {speculate}")
+        if speculate and prefill_chunk is not None:
+            raise ValueError(
+                "speculate is incompatible with prefill_chunk (chunked "
+                "admission interleaves serve_chunk decode cycles; the "
+                "speculative step loop replaces serve_chunk entirely)"
+            )
+        self.speculate = int(speculate)
+        self.spec_ngram = int(spec_ngram)
         self.counters = Counters()
         # optional JSONL span trace (obs/trace.py). Deliberately NOT part of
         # serve_kwargs in snapshot(): an observability knob, not serving
@@ -510,11 +531,17 @@ class PipelineServer:
         )[0]
         act_dtype = leaf.scale.dtype if isinstance(leaf, QTensor) else leaf.dtype
         self._act_dtype = act_dtype
+        # spec mode: K+1 SCRATCH columns over the usable capacity — the
+        # verify forward writes its draft-position KV there, then compacts
+        # the accepted prefix into each row's canonical columns (rollback is
+        # a position rewind, never a copy of live state). Budget validation
+        # everywhere uses the USABLE self.capacity.
+        self._spec_cols = self.speculate + 1 if self.speculate else 0
         self.state = serve_ops.make_state(
             self.cfg,
             self.mesh,
             Lp,
-            capacity=capacity,
+            capacity=capacity + self._spec_cols,
             batch_per_slot=batch_per_slot,
             cache_dtype=engine.cache_dtype,
             act_dtype=act_dtype,
@@ -533,6 +560,12 @@ class PipelineServer:
         # serve wall-clock on the tunneled chip.
         self._mirror_len = np.zeros(M, np.int64)
         self._mirror_budget = np.zeros(M, np.int64)
+        # per-row constant (cache slot − token position), fixed at admission
+        # (spec mode): bucket padding [+ padded-prefix columns − real prefix
+        # length]. serve_verify derives each row's canonical KV slot as
+        # pos + delta — per-row because speculative acceptance diverges row
+        # from row, where the microsteps' shared write_off cannot.
+        self._mirror_cachedelta = np.zeros(M, np.int64)
         self._m = 0  # host mirror of state.m (chunks advance it)
         self._pending: collections.deque = collections.deque()
         self._prefetcher = _Prefetcher.shared()
@@ -540,7 +573,10 @@ class PipelineServer:
         # rows mid-chunked-admission: the slot is parked done on device until
         # serve_admit_finish arms it; no log entries arrive for it
         self._admitting_rows: set[int] = set()
-        self._ids = itertools.count()
+        # plain int, NOT itertools.count: snapshot() must be able to report
+        # the next id WITHOUT consuming one (ADVICE r5 — next(self._ids)
+        # burned a request id on the live daemon per snapshot)
+        self._next_id = 0
         # One lock serializes every public mutation (submit/cancel/step):
         # threaded callers (a request thread cancelling while a pump thread
         # drives step) get a consistent queue/rows/state view, and a cancel
@@ -605,10 +641,14 @@ class PipelineServer:
         stop = self._validate_stop(stop)
         with self._mutex:
             req = Request(
-                next(self._ids), prompt, max_new_tokens,
+                self._new_id(), prompt, max_new_tokens,
                 temperature=temperature, seed=seed, top_k=top_k, top_p=top_p,
                 stop=stop, prefix=prefix,
             )
+            if self.speculate:
+                from .spec import AdaptiveK
+
+                req.spec_k = AdaptiveK(self.speculate)
             if temperature > 0:
                 self._sampling = True
             if top_k > 0 or top_p < 1.0:
@@ -692,7 +732,7 @@ class PipelineServer:
             def req_dict(r: Request) -> Optional[dict]:
                 if r is None:
                     return None
-                return {
+                d = {
                     "id": r.id,
                     "prompt": np.asarray(r.prompt, np.int32),
                     "embeds": None if r.embeds is None else np.asarray(r.embeds),
@@ -707,6 +747,11 @@ class PipelineServer:
                     "done": r.done,
                     "row": r.row,
                 }
+                if r.prefix is not None:
+                    # padded-prefix column count: restore rebuilds the
+                    # per-row cache-offset mirror (spec mode) from it
+                    d["spx"] = r.prefix.spx
+                return d
 
             return {
                 "format": 1,
@@ -718,6 +763,8 @@ class PipelineServer:
                     top_p=self.top_p,
                     prefill_chunk=self.prefill_chunk,
                     pipeline_depth=self.pipeline_depth,
+                    speculate=self.speculate,
+                    spec_ngram=self.spec_ngram,
                 ),
                 "state": jax.tree.map(np.asarray, self.state._asdict()),
                 "m": self._m,
@@ -727,7 +774,8 @@ class PipelineServer:
                 "mirror_budget": self._mirror_budget.copy(),
                 "rows": [req_dict(r) for r in self._rows],
                 "queue": [req_dict(r) for r in self._queue],
-                "next_id": next(self._ids),
+                # read-only: reporting the next id must not consume one
+                "next_id": self._next_id,
                 "counters": self.counters.snapshot(),
             }
 
@@ -736,9 +784,18 @@ class PipelineServer:
         """Rebuild a serving daemon from ``snapshot`` output over an engine
         with the SAME model/placement (same stage count, layer split and
         capacity — the state shapes must match; weights come from the
-        engine, so restore composes with the weights checkpoint path)."""
+        engine, so restore composes with the weights checkpoint path).
+
+        Runs the same engine validation ``PipelineEngine.serve()`` applies
+        (ADVICE r5): restoring onto an in-program-dp engine, or a tp engine
+        of an unsupported model family, raises the curated
+        ``NotImplementedError`` instead of an obscure mesh/sharding error
+        deep in the first dispatched program."""
         if snap.get("format") != 1:
             raise ValueError(f"unknown snapshot format {snap.get('format')!r}")
+        validate = getattr(engine, "_validate_serve", None)
+        if validate is not None:
+            validate()
         srv = cls(engine, **snap["serve_kwargs"])
         host = snap["state"]
         # capture (shape, dtype, sharding) then FREE the zeroed template
@@ -800,6 +857,10 @@ class PipelineServer:
             r.tokens = list(d["tokens"])
             r.done = d["done"]
             r.row = d["row"]
+            if srv.speculate:
+                from .spec import AdaptiveK
+
+                r.spec_k = AdaptiveK(srv.speculate)
             if r.row is not None:
                 r.started_at = time.perf_counter()
             if r.tokens:
@@ -815,10 +876,24 @@ class PipelineServer:
         )
         srv._mirror_len[:] = snap["mirror_len"]
         srv._mirror_budget[:] = snap["mirror_budget"]
+        # per-row slot−position deltas (spec mode) are derivable, not
+        # stored: bucket padding [+ padded-prefix columns − real prefix
+        # length]. mirror_len at admission was pfx_n + prompt_len, so the
+        # prefix's real length falls out of the stored mirrors.
+        for d, r in zip(snap["rows"], srv._rows):
+            if r is None:
+                continue
+            spx = d.get("spx", 0)
+            pfx_n = (
+                int(snap["mirror_len"][r.row]) - len(r.tokens) - r.prompt_len
+            )
+            srv._mirror_cachedelta[r.row] = (
+                spx + srv._bucket(r.prompt_len) - (pfx_n + r.prompt_len)
+            )
         srv._m = snap["m"]
         srv._sampling = snap["sampling"]
         srv._filtering = snap["filtering"]
-        srv._ids = itertools.count(snap["next_id"])
+        srv._next_id = snap["next_id"]
         # from_snapshot, not Counters(**…): a snapshot taken by a build with
         # different counter fields must keep loading (unknown keys ignored,
         # missing ones default)
@@ -864,10 +939,14 @@ class PipelineServer:
         stop = self._validate_stop(stop)
         with self._mutex:
             req = Request(
-                next(self._ids), np.zeros((0,), np.int32), max_new_tokens,
+                self._new_id(), np.zeros((0,), np.int32), max_new_tokens,
                 temperature=temperature, seed=seed, top_k=top_k, top_p=top_p,
                 stop=stop, embeds=h,
             )
+            if self.speculate:
+                from .spec import AdaptiveK
+
+                req.spec_k = AdaptiveK(self.speculate)
             if temperature > 0:
                 self._sampling = True
             if top_k > 0 or top_p < 1.0:
@@ -894,7 +973,12 @@ class PipelineServer:
         Each phase records its duration under
         ``server_step_phase_seconds{phase=admit|dispatch|apply}`` — note the
         dispatch figure is HOST dispatch time (the chunk executes async on
-        device); with ``trace_path=`` the phases also land as JSONL spans."""
+        device); with ``trace_path=`` the phases also land as JSONL spans.
+
+        With ``speculate=K`` the decode chunk is replaced by per-slot
+        ``serve_verify`` traversals (``_spec_step``): each commits a
+        VARIABLE number of tokens per row and its log is drained within the
+        same step — the next step's drafts need the committed ids."""
         with self._mutex:
             progressed = False
             if self._queue and self._free_slots():
@@ -909,7 +993,19 @@ class PipelineServer:
                 _M_STEP_PHASE.labels(phase="admit").observe(
                     time.perf_counter() - t0
                 )
-            if self._any_active():
+            if self.speculate and self._any_active():
+                # speculative decode replaces the interleaved chunk: per
+                # active slot, draft on host, verify K+1 positions in one
+                # forward, commit a variable number of tokens per row
+                t0 = time.perf_counter()
+                self._spec_step()
+                progressed = True
+                _M_STEP_PHASE.labels(phase="dispatch").observe(
+                    time.perf_counter() - t0
+                )
+                t0 = time.perf_counter()
+                applied = self._drain(0)  # next drafts need these commits
+            elif self._any_active():
                 t0 = time.perf_counter()
                 cycles = self.num_stages * self.chunk_cycles
                 record_shape_key(
@@ -1035,6 +1131,11 @@ class PipelineServer:
             self.step()
 
     # ------------------------------------------------------------ internals
+
+    def _new_id(self) -> int:
+        rid = self._next_id
+        self._next_id += 1
+        return rid
 
     def _resolve_filters(self, top_k, top_p) -> tuple:
         """Per-request top-k/top-p resolved against the server defaults,
@@ -1196,6 +1297,14 @@ class PipelineServer:
                 pfx_n = 0 if pfx is None else pfx.n
                 self._mirror_len[r.row] = pfx_n + r.prompt_len
                 self._mirror_budget[r.row] = pfx_n + r.prompt_len + r.max_new
+                # spec mode: the pending token's KV lands right after the
+                # admission bucket (plus any padded-prefix columns); its
+                # position is pfx_n + prompt_len — the difference is the
+                # row's constant slot−position delta
+                self._mirror_cachedelta[r.row] = (
+                    (0 if pfx is None else pfx.spx) + bucket
+                    - (pfx_n + r.prompt_len)
+                )
             serve_ops.ADMIT_BUCKET_USED.labels(bucket=str(bucket)).inc()
             if not is_emb and pfx is None and self._chunked(bucket):
                 self._admit_chunked(
@@ -1360,6 +1469,112 @@ class PipelineServer:
         )
         self._admitting_rows.difference_update(range(row0, row0 + Bs))
 
+    def _spec_step(self) -> None:
+        """One speculative decode round: for every slot with live rows,
+        draft per row from the request's own ids (host-side n-gram lookup),
+        dispatch ONE ``serve_verify`` traversal over the K+1 draft positions,
+        and queue its commit log. All slots' verifies are dispatched before
+        any log is fetched (the device queue stays full); the caller drains
+        immediately after — the next round's drafts need these commits.
+
+        Drafting reads ``req.prompt + req.tokens``: for prefix-handle
+        requests that is the SUFFIX + generation (the shared prefix's ids
+        live in the handle, not the request, so they don't participate in
+        the lookup — acceptable: the suffix+generation window is where
+        self-repetition lives)."""
+        from .spec import ngram_draft
+
+        K = self.speculate
+        Bs = self.batch_per_slot
+        for slot in range(self.num_stages):
+            rows = range(slot * Bs, (slot + 1) * Bs)
+            live = [
+                (r, self._rows[r]) for r in rows
+                if self._rows[r] is not None and not self._rows[r].done
+            ]
+            if not live:
+                continue
+            draft = np.zeros((Bs, K), np.int32)
+            draft_len = np.zeros((Bs,), np.int32)
+            cache_delta = np.zeros((Bs,), np.int32)
+            for row, req in live:
+                i = row - slot * Bs
+                ids = np.concatenate(
+                    [np.asarray(req.prompt, np.int64), req.tokens]
+                ) if req.tokens else np.asarray(req.prompt, np.int64)
+                d = ngram_draft(ids, req.spec_k.k, self.spec_ngram)
+                draft[i, : d.shape[0]] = d
+                draft_len[i] = d.shape[0]
+                cache_delta[i] = self._mirror_cachedelta[row]
+            record_shape_key(
+                "serve_verify",
+                (self.num_stages, Bs, self.capacity, K, self._sampling,
+                 self._filtering, self.tp),
+            )
+            self.state, log = serve_ops.serve_verify(
+                self.cfg,
+                self.mesh,
+                self.engine.stage_layers,
+                self.engine.layer_masks,
+                self.engine.head_params,
+                self.state,
+                jnp.asarray(draft),
+                jnp.asarray(draft_len),
+                jnp.asarray(slot, jnp.int32),
+                jnp.asarray(cache_delta),
+                self.num_stages,
+                K,
+                self._sampling,
+                self._filtering,
+                tp=self.tp,
+            )
+            self._pending.append(
+                (
+                    "spec",
+                    self._prefetcher.fetch(log, tag=f"verify slot={slot}"),
+                    [
+                        (row, req, int(draft_len[row - slot * Bs]),
+                         draft[row - slot * Bs].copy())
+                        for row, req in live
+                    ],
+                )
+            )
+            self.counters.inc("chunks")
+
+    def _apply_spec(self, log: np.ndarray, entries: list) -> None:
+        """Replay one verify's commit log ([Bs, K+1], -1 padded): a
+        VARIABLE-length run per row. EOS and budget cuts already happened on
+        device (the log is -1 past them); the host replays each token
+        through the same ``_apply_token`` path chunk logs use — stop-string
+        scans cover the whole committed run, and a stop hit truncates and
+        cancels the row mid-run exactly like in chunk mode. The adaptive
+        draft width and the spec metrics update from (drafted, accepted)."""
+        from .spec import (
+            M_SPEC_ACC_RATE, M_SPEC_ACCEPTED, M_SPEC_DRAFTED,
+            M_SPEC_TOKENS_PER_STEP, count_accepted,
+        )
+
+        Bs = self.batch_per_slot
+        for row, req, drafted, draft_row in entries:
+            if self._rows[row] is not req:
+                continue  # replaced between dispatch and drain
+            committed = [int(t) for t in log[row % Bs] if t >= 0]
+            # leading match vs the draft, NOT len-1: a run cut by an
+            # accepted-EOS draft or the budget has no trailing bonus token
+            accepted = count_accepted(committed, draft_row, drafted)
+            if req.spec_k is not None:
+                req.spec_k.update(drafted, accepted)
+            if drafted:
+                M_SPEC_DRAFTED.inc(drafted)
+                M_SPEC_ACCEPTED.inc(accepted)
+                M_SPEC_ACC_RATE.observe(accepted / drafted)
+            if committed:
+                M_SPEC_TOKENS_PER_STEP.observe(len(committed))
+            for t in committed:
+                if req.done:
+                    break  # stop-string truncation mid-run
+                self._apply_token(row, req, t)
+
     def _drain(self, max_pending: int) -> int:
         """Apply queued device reads until at most ``max_pending`` remain.
         ``max_pending=1`` is the steady-state pipeline depth (the newest
@@ -1372,6 +1587,8 @@ class PipelineServer:
             applied += 1
             if entry[0] == "chunk":
                 self._apply_log(entry[1].get(), entry[2])
+            elif entry[0] == "spec":
+                self._apply_spec(entry[1].get(), entry[2])
             else:  # "admit": per-row first tokens from serve_admit
                 tok0 = entry[1].get()
                 for i, (row, req) in enumerate(entry[2]):

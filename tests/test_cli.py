@@ -158,6 +158,70 @@ def test_serve_snapshot_restore_cli(shards, tmp_path, capsys, monkeypatch):
     assert len([l for l in captured.out.splitlines() if l.strip()]) == 1
 
 
+def test_serve_restore_banner_reports_snapshot_flags(
+    shards, tmp_path, capsys, monkeypatch
+):
+    """--restore with serve flags that differ from the snapshot: the banner
+    must report the capacity the daemon ACTUALLY runs at (the snapshot's)
+    and warn that the differing CLI flags are ignored (ADVICE r5 — the old
+    banner printed args.capacity while serve_kwargs silently won)."""
+    from llm_sharding_tpu.runtime import engine as engine_mod
+
+    monkeypatch.setattr(
+        engine_mod.PipelineEngine,
+        "_require_tokenizer",
+        lambda self: IdTokenizer(),
+    )
+    d = str(tmp_path / "snap2")
+    monkeypatch.setattr(
+        "sys.stdin", io.StringIO(f"one prompt\n:snapshot {d}\n")
+    )
+    rc = cli.main(
+        [
+            "serve", shards, "--max-new", "4", "--stages", "4",
+            "--capacity", "64", "--dtype", "f32",
+        ]
+    )
+    assert rc == 0
+    capsys.readouterr()
+
+    monkeypatch.setattr("sys.stdin", io.StringIO(""))
+    rc = cli.main(
+        [
+            "serve", shards, "--max-new", "4", "--stages", "4",
+            "--capacity", "128", "--dtype", "f32", "--restore", d,
+        ]
+    )
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "capacity=64" in err  # the snapshot's, not the CLI's 128
+    assert "capacity=128" not in err.replace("--capacity 128", "")
+    assert "ignored" in err and "--capacity 128" in err
+
+
+def test_serve_speculate_cli(shards, capsys, monkeypatch):
+    """--speculate K drives the speculative serve loop end to end from the
+    CLI (stdin prompt → streamed completion), and the banner still prints."""
+    from llm_sharding_tpu.runtime import engine as engine_mod
+
+    monkeypatch.setattr(
+        engine_mod.PipelineEngine,
+        "_require_tokenizer",
+        lambda self: IdTokenizer(),
+    )
+    monkeypatch.setattr("sys.stdin", io.StringIO("hello spec world\n"))
+    rc = cli.main(
+        [
+            "serve", shards, "--max-new", "6", "--stages", "4",
+            "--capacity", "64", "--dtype", "f32", "--speculate", "2",
+        ]
+    )
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert '"requests_completed": 1' in captured.err
+    assert len(captured.out.strip()) > 0
+
+
 def test_profile_command_artifacts(tmp_path, capsys):
     out_dir = str(tmp_path / "prof")
     rc = cli.main(

@@ -162,3 +162,29 @@ def test_prefix_validation(setup):
         srv.submit(np.ones((8,), np.int32), max_new_tokens=64, prefix=h)
     with pytest.raises(ValueError, match="non-empty"):
         srv.prefill_prefix(np.zeros((0,), np.int32))
+
+
+def test_prefix_admission_out_columns_prefix_inclusive(setup):
+    """``state.out`` column == PREFIX-INCLUSIVE sequence index for the
+    generated run (ADVICE r5): tok0 lands at column ``prefix_n + suffix_len``
+    and every chunk commit follows contiguously — no n-column gap between
+    the admission-sampled token and the decode commits. (Suffix ids stay at
+    columns [0, suffix_len); the prefix's ids live in the handle, not in
+    ``out`` — columns [suffix_len, total) are zero by construction.)"""
+    params, eng = setup
+    srv = eng.serve(capacity=128)
+    rng = np.random.default_rng(29)
+    prefix = rng.integers(1, CFG.vocab_size, 10).astype(np.int32)
+    h = srv.prefill_prefix(prefix)
+    sfx = rng.integers(1, CFG.vocab_size, 4).astype(np.int32)
+    r = srv.submit(sfx, max_new_tokens=6, prefix=h)
+    srv.run_until_idle()
+    assert r.tokens == oracle(params, np.concatenate([prefix, sfx]), 6)
+
+    out = np.asarray(srv.state.out)[r.row if r.row is not None else 0]
+    total = h.n + len(sfx)
+    # suffix at [0, len); zeros through the prefix gap; the generated run
+    # contiguous from the prefix-inclusive column `total`
+    np.testing.assert_array_equal(out[: len(sfx)], sfx)
+    assert list(out[total : total + len(r.tokens)]) == r.tokens
+    assert not np.any(out[len(sfx) : total])

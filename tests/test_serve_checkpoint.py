@@ -177,3 +177,35 @@ def test_snapshot_refuses_queued_prefix(setup):
     assert blockers  # silence lint
     with pytest.raises(ValueError, match="prefix"):
         srv.snapshot()
+
+
+def test_snapshot_is_read_only_on_request_ids(setup):
+    """snapshot() must not consume a request id (ADVICE r5: the old
+    itertools.count-based tracking burned one per snapshot on the live
+    daemon) — a request submitted after N snapshots still gets the next
+    consecutive id."""
+    _, eng = setup
+    srv = eng.serve(capacity=64)
+    r0 = srv.submit(np.array([1, 2, 3], np.int32), 2)
+    srv.run_until_idle()
+    for _ in range(3):
+        snap = srv.snapshot()
+    assert snap["next_id"] == r0.id + 1
+    r1 = srv.submit(np.array([4, 5], np.int32), 2)
+    assert r1.id == r0.id + 1
+    srv.run_until_idle()
+
+
+def test_restore_runs_engine_serve_validation(setup):
+    """restore() applies the same engine guards serve() does (ADVICE r5):
+    an in-program-dp engine gets the curated NotImplementedError pointing
+    at ReplicatedServer, not an obscure mesh/sharding failure later."""
+    params, eng = setup
+    srv = eng.serve(capacity=64)
+    snap = srv.snapshot()
+    eng_dp = PipelineEngine(
+        CFG, llama.init_params(CFG, jax.random.key(17), dtype=jnp.float32),
+        data_parallel=2, num_stages=2, cache_dtype=jnp.float32,
+    )
+    with pytest.raises(NotImplementedError, match="ReplicatedServer"):
+        PipelineServer.restore(eng_dp, snap)

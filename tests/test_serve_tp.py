@@ -115,3 +115,21 @@ def test_serve_tp_gpt2_rejected():
     )
     with pytest.raises(NotImplementedError, match="serve×tp"):
         eng.serve(capacity=32)
+
+
+@pytest.mark.slow  # ~40 s: a pp2×tp2 serve_verify compile on the CPU mesh
+def test_serve_tp_speculative(setup):
+    """Speculative decode composes with tensor parallelism: serve_verify's
+    ring traversal runs megatron-sharded stage fns and its greedy argmax is
+    assembled over the vocab-sharded head — token-exact vs the monolith,
+    two concurrent rows."""
+    params, eng = setup
+    srv = eng.serve(capacity=64, speculate=3)
+    rng = np.random.default_rng(39)
+    pa = rng.integers(1, CFG.vocab_size, 5).astype(np.int32)
+    pb = rng.integers(1, CFG.vocab_size, 4).astype(np.int32)
+    ra = srv.submit(pa, max_new_tokens=12)
+    rb = srv.submit(pb, max_new_tokens=9)
+    srv.run_until_idle()
+    assert ra.tokens == oracle(params, pa, 12)
+    assert rb.tokens == oracle(params, pb, 9)
