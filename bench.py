@@ -423,6 +423,75 @@ def bench_prefix_cache(on_tpu, engine):
     )
 
 
+def bench_fault_serve(on_tpu, engine):
+    """Robustness overhead: steady-state serve throughput under a FIXED
+    deterministic transient-fault rate (chunk dispatch + log fetch, seeded
+    FaultPlan) vs the clean run on the same server shape. The faulted run
+    must stay token-identical (greedy retries are exactness-preserving), so
+    the emitted ratio is pure recovery cost — retry backoff plus the odd
+    re-dispatched chunk — and a regression here means the resilience layer
+    started taxing the hot path."""
+    from llm_sharding_tpu.runtime.faults import FaultPlan
+
+    name = (
+        "serve_fault_recovery_tok_s_llama3.2-3b_1stage" if on_tpu
+        else "serve_fault_recovery_tok_s_tiny_cpu"
+    )
+    if on_tpu:
+        batch_per_slot, capacity, chunk_cycles, depth = 8, 320, 8, 2
+        prompt_len, max_new = 32, 128
+    else:
+        batch_per_slot, capacity, chunk_cycles, depth = 2, 64, 2, 1
+        prompt_len, max_new = 8, 16
+    cfg = engine.cfg
+    rate = 0.05
+
+    def run(plan):
+        srv = engine.serve(
+            capacity=capacity, batch_per_slot=batch_per_slot,
+            chunk_cycles=chunk_cycles, pipeline_depth=depth,
+            fault_plan=plan, fault_backoff_s=0.001,
+        )
+        rng = np.random.default_rng(7)
+        reqs = [
+            srv.submit(
+                rng.integers(0, cfg.vocab_size, prompt_len).astype(np.int32),
+                max_new_tokens=max_new,
+            )
+            for _ in range(batch_per_slot)
+        ]
+        t0 = time.perf_counter()
+        srv.run_until_idle()
+        dt = time.perf_counter() - t0
+        toks = [list(r.tokens) for r in reqs]
+        tok_s = sum(len(t) for t in toks) / dt
+        del srv
+        gc.collect()
+        return tok_s, toks
+
+    run(None)  # compile admit + chunk programs
+    clean_tok_s, clean_toks = run(None)
+    plan = FaultPlan.rates(seed=11, chunk_dispatch=rate, log_fetch=rate)
+    fault_tok_s, fault_toks = run(plan)
+    if fault_toks != clean_toks:
+        # loud failure, not a buried extras field: injected transients are
+        # retried with identical re-dispatches, so any divergence means the
+        # resilience layer broke exactness — the headline must not ship
+        raise RuntimeError(
+            "faulted serve output diverged from the clean run "
+            f"({sum(len(t) for t in fault_toks)} vs "
+            f"{sum(len(t) for t in clean_toks)} tokens)"
+        )
+    emit(
+        name, fault_tok_s, "tokens/sec", fault_tok_s / ANCHOR_TOK_S,
+        clean_tok_s=round(clean_tok_s, 2),
+        recovered_frac=round(fault_tok_s / max(clean_tok_s, 1e-9), 3),
+        fault_rate=rate,
+        token_identical=(fault_toks == clean_toks),
+        faults=plan.stats()["total_fires"],
+    )
+
+
 def bench_spec(on_tpu, cfg, params, jax, jnp):
     """Speculative decoding (n-gram self-drafting, runtime/spec.py) on a
     LOOKUP-FRIENDLY workload: the prompt is self-primed — the model's own
@@ -664,6 +733,10 @@ def main():
         "hop_latency_p50_us_1chip_loopback" if on_tpu
         else f"hop_latency_p50_us_cpu_ring{len(jax.devices())}"
     )
+    nfault = (
+        "serve_fault_recovery_tok_s_llama3.2-3b_1stage" if on_tpu
+        else "serve_fault_recovery_tok_s_tiny_cpu"
+    )
 
     # section order = survival priority under a driver-side timeout:
     # 3B (anchor emitted immediately) → serve → 3B-int8 → pallas → 7B(+int8)
@@ -707,6 +780,18 @@ def main():
                 bench_prefix_cache(on_tpu, serve_engine)
             except Exception as e:  # noqa: BLE001
                 emit_error(nprefix, "x_speedup_vs_full_prefill", e)
+        # fault-injection serve (robustness overhead) reuses the serve
+        # engine before it is torn down
+        if serve_engine is None:
+            emit_error(nfault, "tokens/sec",
+                       "not attempted: serve engine unavailable")
+        elif remaining() < 120:
+            emit_skip(nfault, "tokens/sec", 120)
+        else:
+            try:
+                bench_fault_serve(on_tpu, serve_engine)
+            except Exception as e:  # noqa: BLE001
+                emit_error(nfault, "tokens/sec", e)
         del serve_engine
         gc.collect()
         # speculative decode BEFORE int8: it reuses the live bf16 device

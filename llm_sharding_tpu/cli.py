@@ -11,8 +11,11 @@ those five entry points into subcommands:
   but pipelined; ``--stream`` streams tokens from the sharded program)
 - ``serve``    — persistent interactive daemon over stdin (≙ ``start_node.py``
   + ``run_worker_loop``), continuous batching underneath; ``--metrics-port``
-  exposes /metrics (Prometheus) + /statz (JSON), ``--trace-path`` streams
-  JSONL latency spans, ``:stats`` prints the telemetry snapshot in-band
+  exposes /metrics (Prometheus) + /statz (JSON) + a live /healthz,
+  ``--trace-path`` streams JSONL latency spans, ``:stats`` prints the
+  telemetry snapshot in-band; ``--max-queue``/``--default-deadline`` shed
+  load, ``--snapshot-every``/``--snapshot-dir`` auto-checkpoint for crash
+  recovery (``--restore DIR`` resumes)
 - ``profile``  — capability sweeps, hop latency, artifacts + an optional
   capability-weighted placement suggestion (≙ ``profiling.py``; closes the
   profiler→scheduler loop of the reference's README)
@@ -218,6 +221,14 @@ def _serve_control(eng, srv, line: str, args):
                 trace_path=getattr(args, "trace_path", None),
                 speculate=getattr(args, "speculate", 0),
                 spec_ngram=getattr(args, "spec_ngram", 3),
+                max_queue=getattr(args, "max_queue", 0) or None,
+                default_deadline_s=(
+                    getattr(args, "default_deadline", 0.0) or None
+                ),
+                snapshot_every_s=(
+                    getattr(args, "snapshot_every", 0.0) or None
+                ),
+                snapshot_path=getattr(args, "snapshot_dir", None),
             )
 
         try:
@@ -267,6 +278,19 @@ def cmd_serve(args) -> int:
     completion per line (≙ the reference's forever-spinning worker loop).
     Lines starting with ``:`` are operator control commands — see
     ``_serve_control`` (hot repartition without restarting the daemon)."""
+    from .runtime.server import QueueFull, RequestFailed, ServerClosed
+
+    # fail the flag mismatch in milliseconds, not after minutes of model
+    # loading (PipelineServer validates the same pairing, but only once the
+    # engine is up)
+    if bool(args.snapshot_every) != bool(args.snapshot_dir):
+        print(
+            "error: --snapshot-every and --snapshot-dir go together "
+            f"(got --snapshot-every {args.snapshot_every or 0}, "
+            f"--snapshot-dir {args.snapshot_dir!r})",
+            file=sys.stderr,
+        )
+        return 2
     if getattr(args, "data_parallel", 1) > 1:
         # data-parallel daemon: D replica servers over disjoint device
         # groups behind a router (runtime/replicated.py). :placement is a
@@ -302,6 +326,10 @@ def cmd_serve(args) -> int:
             trace_path=args.trace_path,
             speculate=args.speculate,
             spec_ngram=args.spec_ngram,
+            max_queue=args.max_queue or None,
+            default_deadline_s=args.default_deadline or None,
+            snapshot_every_s=args.snapshot_every or None,
+            snapshot_path=args.snapshot_dir,
         )
         eng = srv.engines[0]
         print(
@@ -318,6 +346,12 @@ def cmd_serve(args) -> int:
             from .runtime.server import PipelineServer, load_snapshot
 
             srv = PipelineServer.restore(eng, load_snapshot(args.restore))
+            if args.snapshot_every or args.snapshot_dir:
+                # ops knobs never ride in the snapshot's serve_kwargs — the
+                # revived daemon re-arms auto-snapshot from the CLI flags
+                srv.enable_auto_snapshot(
+                    args.snapshot_dir, args.snapshot_every or None
+                )
             if args.trace_path:
                 # the snapshot's serve_kwargs never carry observability
                 # knobs — attach the trace to the revived daemon directly
@@ -349,6 +383,9 @@ def cmd_serve(args) -> int:
                      srv.speculate),
                     ("spec_ngram", getattr(args, "spec_ngram", 3),
                      srv.spec_ngram),
+                    ("max_queue", args.max_queue or None, srv.max_queue),
+                    ("default_deadline", args.default_deadline or None,
+                     srv.default_deadline_s),
                 )
                 if got != used
             ]
@@ -377,6 +414,10 @@ def cmd_serve(args) -> int:
                 trace_path=args.trace_path,
                 speculate=args.speculate,
                 spec_ngram=args.spec_ngram,
+                max_queue=args.max_queue or None,
+                default_deadline_s=args.default_deadline or None,
+                snapshot_every_s=args.snapshot_every or None,
+                snapshot_path=args.snapshot_dir,
             )
         # srv.capacity, not args.capacity: after --restore the daemon runs
         # at the SNAPSHOT's serve_kwargs (ADVICE r5 — the banner used to
@@ -399,6 +440,9 @@ def cmd_serve(args) -> int:
                 if getattr(args, "data_parallel", 1) > 1 else {}
             ),
         },
+        # /healthz now answers from the LIVE state machine: 503 on
+        # DEGRADED/DRAINING so a load balancer rotates the daemon out
+        health=lambda: srv.health,
     )
     tok = eng._require_tokenizer()
     n_prompt = 0
@@ -416,19 +460,31 @@ def cmd_serve(args) -> int:
         ids = np.asarray(tok(prompt)["input_ids"], np.int32)
         # per-request seed advances from --seed so two identical sampled
         # prompts in one session draw different completions (ADVICE r3 #3)
-        req = srv.submit(
-            ids, args.max_new, temperature=args.temperature,
-            seed=args.seed + n_prompt, stop=args.stop,
-        )
+        try:
+            req = srv.submit(
+                ids, args.max_new, temperature=args.temperature,
+                seed=args.seed + n_prompt, stop=args.stop,
+            )
+        except (QueueFull, ServerClosed, ValueError) as e:
+            # backpressure and bad requests (prompt too long for the model,
+            # over-capacity max_new) are NORMAL answers, not crashes:
+            # report the rejection and keep the daemon reading prompts
+            print(f"rejected: {e}", file=sys.stderr)
+            continue
         n_prompt += 1
         acc: list[int] = []
         prev = ""
-        for t in srv.stream(req):
-            acc.append(t)
-            text = tok.decode(acc, skip_special_tokens=True)
-            if len(text) > len(prev) and not text.endswith("�"):
-                print(text[len(prev):], end="", flush=True)
-                prev = text
+        try:
+            for t in srv.stream(req):
+                acc.append(t)
+                text = tok.decode(acc, skip_special_tokens=True)
+                if len(text) > len(prev) and not text.endswith("�"):
+                    print(text[len(prev):], end="", flush=True)
+                    prev = text
+        except RequestFailed as e:
+            # deadline expiry / contained failure: the partial completion
+            # already streamed; name the cause and keep serving
+            print(f"\n[request failed: {e.__cause__ or e}]", file=sys.stderr)
         print(flush=True)
     print(json.dumps(srv.counters.snapshot()), file=sys.stderr)
     if metrics_srv is not None:
@@ -438,17 +494,21 @@ def cmd_serve(args) -> int:
     return 0
 
 
-def _start_metrics(port, statz_extra=None):
+def _start_metrics(port, statz_extra=None, health=None):
     """Start the background ``/metrics`` + ``/statz`` exposition thread when
     a port is requested (0/None = disabled). Returns the MetricsServer or
     None. Bind failures (port taken) are reported and non-fatal — the daemon
-    serves without exposition rather than dying."""
+    serves without exposition rather than dying. ``health`` (a zero-arg
+    callable returning the state name) makes ``/healthz`` answer 503 unless
+    the state is SERVING."""
     if not port:
         return None
     from .obs.http import MetricsServer
 
     try:
-        ms = MetricsServer(port=port, statz_extra=statz_extra)
+        ms = MetricsServer(
+            port=port, statz_extra=statz_extra, health_provider=health
+        )
         ms.start()
     except OSError as e:
         print(f"metrics endpoint disabled: {e}", file=sys.stderr)
@@ -795,6 +855,30 @@ def build_parser() -> argparse.ArgumentParser:
         "text contains it",
     )
     s.add_argument(
+        "--max-queue", type=int, default=0, dest="max_queue",
+        help="admission control: reject submits (QueueFull) once this many "
+        "requests are waiting for a slot (0 = unbounded) — backpressure "
+        "instead of an ever-growing backlog in front of a saturated device",
+    )
+    s.add_argument(
+        "--default-deadline", type=float, default=0.0,
+        dest="default_deadline",
+        help="default per-request deadline in seconds from submission "
+        "(0 = none): still queued past it -> shed at admit time; "
+        "mid-decode past it -> cancelled at the next chunk boundary",
+    )
+    s.add_argument(
+        "--snapshot-every", type=float, default=0.0, dest="snapshot_every",
+        help="auto-checkpoint the live daemon at most every N seconds "
+        "(atomic tmp+rename into --snapshot-dir; 0 = off); crash recovery "
+        "is 'serve --restore SNAPSHOT_DIR'",
+    )
+    s.add_argument(
+        "--snapshot-dir", default=None, dest="snapshot_dir",
+        help="directory for --snapshot-every checkpoints (with "
+        "--data-parallel each replica writes DIR.r<i>)",
+    )
+    s.add_argument(
         "--restore", default=None,
         help="resume a ':snapshot DIR' checkpoint: device serve state + "
         "in-flight/queued requests continue token-exactly (placement and "
@@ -906,8 +990,27 @@ def main(argv=None) -> int:
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
     # persist compiled executables across daemon restarts/repeat runs
-    # (LLM_SHARDING_TPU_CACHE=off to disable; utils/compile_cache.py)
-    from .utils.compile_cache import enable_persistent_cache
+    # (LLM_SHARDING_TPU_CACHE=off to disable; utils/compile_cache.py).
+    # Skipped on the CPU backend: XLA:CPU AOT artifacts are machine-pinned
+    # — a NEW process reloading them is at best portability-error noise
+    # and at worst a hang or segfault at executable deserialization
+    # (observed driving `serve --restore` on the CPU mesh), which would
+    # turn the crash-RECOVERY restart into a second crash. Same gate
+    # bench.py applies via its on_tpu probe. Every command but `worker`
+    # initializes the backend in-process anyway, so the authoritative
+    # jax.devices() probe is safe; `worker` must not touch the backend
+    # before jax.distributed.initialize, so it falls back to the env var.
+    if args.command == "worker":
+        on_cpu = (
+            os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
+            == "cpu"
+        )
+    else:
+        import jax
 
-    enable_persistent_cache()
+        on_cpu = jax.devices()[0].platform == "cpu"
+    if not on_cpu:
+        from .utils.compile_cache import enable_persistent_cache
+
+        enable_persistent_cache()
     return args.fn(args)

@@ -19,6 +19,7 @@ from .metrics import (  # noqa: F401
     DEFAULT_RATE_BUCKETS,
     REGISTRY,
     Registry,
+    StateGauge,
     record_shape_key,
 )
 from .trace import TraceWriter  # noqa: F401

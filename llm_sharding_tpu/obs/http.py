@@ -6,7 +6,12 @@
 - ``/statz``   — JSON: the registry snapshot (histograms with p50/p90/p99)
   plus any extra named providers (the serve daemon registers its live
   ``Counters.snapshot`` so ``/statz`` carries the exact per-server tally);
-- ``/healthz`` — liveness probe (200 ``ok``).
+- ``/healthz`` — health probe. Without a ``health_provider`` it is a bare
+  liveness check (200 ``ok``); with one (the serve CLI attaches the live
+  server's health state machine) it returns 200 ``ok`` only while the
+  provider reports ``SERVING``, and 503 with the state name
+  (``DEGRADED``/``DRAINING``) otherwise — so a load balancer can pull a
+  degraded or draining daemon out of rotation instead of timing out on it.
 
 Wired into ``cli.py serve/worker/launch`` via ``--metrics-port``; binds
 ``port=0`` to an ephemeral port (returned by ``start()``) for tests. The
@@ -31,9 +36,11 @@ class MetricsServer:
         host: str = "127.0.0.1",
         registry: Optional[Registry] = None,
         statz_extra: Optional[Dict[str, Callable[[], object]]] = None,
+        health_provider: Optional[Callable[[], str]] = None,
     ):
         self.registry = registry if registry is not None else REGISTRY
         self._extra: Dict[str, Callable[[], object]] = dict(statz_extra or {})
+        self._health = health_provider
         self._httpd = ThreadingHTTPServer(
             (host, port), self._handler_class()
         )
@@ -49,6 +56,29 @@ class MetricsServer:
         """Register (or replace) a named JSON provider under ``/statz`` —
         e.g. the live server's counters, per-replica queue depths."""
         self._extra[name] = provider
+
+    def set_health_provider(
+        self, provider: Optional[Callable[[], str]]
+    ) -> None:
+        """Attach (or detach with ``None``) the live health source —
+        a zero-arg callable returning the server's state name
+        (``SERVING``/``DEGRADED``/``DRAINING``). ``/healthz`` turns 503 for
+        anything but ``SERVING``."""
+        self._health = provider
+
+    def _health_response(self) -> tuple:
+        """(status_code, body) for ``/healthz``. A provider that raises
+        reports 503 rather than taking the endpoint down — an unreadable
+        health state IS unhealthy as far as a load balancer is concerned."""
+        if self._health is None:
+            return 200, b"ok\n"
+        try:
+            state = str(self._health())
+        except Exception as e:  # noqa: BLE001 — surfaced as unhealthy
+            return 503, f"unhealthy: health provider failed: {e}\n"[:500].encode()
+        if state == "SERVING":
+            return 200, b"ok\n"
+        return 503, f"{state}\n".encode()
 
     def start(self) -> int:
         if not self._started:
@@ -80,6 +110,7 @@ class MetricsServer:
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
                 path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                code = 200
                 if path == "/metrics":
                     body = server.registry.prometheus_text().encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
@@ -89,11 +120,12 @@ class MetricsServer:
                     ).encode()
                     ctype = "application/json"
                 elif path == "/healthz":
-                    body, ctype = b"ok\n", "text/plain; charset=utf-8"
+                    code, body = server._health_response()
+                    ctype = "text/plain; charset=utf-8"
                 else:
                     self.send_error(404, "try /metrics, /statz or /healthz")
                     return
-                self.send_response(200)
+                self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

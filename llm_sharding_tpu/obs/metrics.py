@@ -244,6 +244,40 @@ class _Family:
             return sorted(self._children.items())
 
 
+class StateGauge:
+    """A one-hot state machine over a labeled gauge family: exactly one
+    ``state`` label holds 1.0 at any time (the Prometheus idiom for enum
+    state — ``server_health_state{state="SERVING"} 1`` — scrapers alert on
+    ``{state="DEGRADED"} == 1`` without string parsing). ``set_state``
+    serializes writers under its own lock, so concurrent transitions can
+    never interleave into two states at 1; a scrape can at worst observe
+    the one-hot mid-flip, never a stale extra state left behind."""
+
+    __slots__ = ("_family", "states", "_state", "_set_lock")
+
+    def __init__(self, family: "_Family", states: Tuple[str, ...]):
+        self._family = family
+        self.states = states
+        self._state: Optional[str] = None
+        self._set_lock = threading.Lock()
+        for s in states:  # materialize every label so scrapes see the 0s
+            family.labels(state=s).set(0.0)
+
+    def set_state(self, state: str) -> None:
+        if state not in self.states:
+            raise ValueError(
+                f"unknown state {state!r}; expected one of {self.states}"
+            )
+        with self._set_lock:
+            for s in self.states:
+                self._family.labels(state=s).set(1.0 if s == state else 0.0)
+            self._state = state
+
+    @property
+    def state(self) -> Optional[str]:
+        return self._state
+
+
 class Registry:
     """Thread-safe named collection of metric families. Registration is
     get-or-create: re-registering the same (name, kind, labels) returns the
@@ -293,6 +327,16 @@ class Registry:
         if not buckets:
             raise ValueError("histogram needs at least one finite bucket")
         return self._register("histogram", name, help, labels, buckets)
+
+    def state_gauge(
+        self, name: str, help: str = "", states: Sequence[str] = ()
+    ) -> StateGauge:
+        """A one-hot enum gauge (see ``StateGauge``), labeled ``state``."""
+        if not states:
+            raise ValueError("state_gauge needs at least one state")
+        return StateGauge(
+            self._register("gauge", name, help, ("state",)), tuple(states)
+        )
 
     def get(self, name: str) -> Optional[_Family]:
         with self._lock:

@@ -260,12 +260,35 @@ def prefix_prefill(
 
 @functools.partial(jax.jit, donate_argnums=(0,))
 def serve_cancel_rows(state: ServeState, rows_mask: jnp.ndarray) -> ServeState:
-    """Mark rows done from the host between chunks (request cancellation and
-    host-side stop sequences). Safe by the same mechanism EOS uses: a row
-    whose ``done`` flips at a chunk boundary stops committing tokens, its
-    in-flight block is dropped by the post-update validity gating in
-    ``serve_chunk``, and the slot frees once all its rows are done."""
+    """Mark rows done from the host between chunks (request cancellation,
+    host-side stop sequences, deadline expiry, failure containment). Safe by
+    the same mechanism EOS uses: a row whose ``done`` flips at a chunk
+    boundary stops committing tokens, its in-flight block is dropped by the
+    post-update validity gating in ``serve_chunk``, and the slot frees once
+    all its rows are done."""
     return state._replace(done=state.done | rows_mask)
+
+
+# Rows cancelled per serve_cancel_rows dispatch: the deadline sweep and the
+# failure-containment paths batch every row they stop into ONE device call
+# per step — a per-row dispatch would pay one host→device round trip per
+# straggler under deadline pressure, exactly when the server is busiest.
+CANCEL_BATCH_ROWS = REGISTRY.histogram(
+    "server_cancel_batch_rows",
+    "Rows stopped per batched serve_cancel_rows dispatch (cancel, deadline "
+    "sweep, failure containment)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+)
+
+
+def cancel_rows_batched(state: ServeState, rows, n_rows: int) -> ServeState:
+    """Stop every row in ``rows`` with one ``serve_cancel_rows`` dispatch.
+    ``n_rows`` is the server's total row count (stages × batch_per_slot)."""
+    rows = list(rows)
+    mask = np.zeros((n_rows,), bool)
+    mask[rows] = True
+    CANCEL_BATCH_ROWS.observe(len(rows))
+    return serve_cancel_rows(state, jnp.asarray(mask))
 
 
 @functools.partial(

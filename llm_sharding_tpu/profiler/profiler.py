@@ -537,6 +537,52 @@ class HopLatencyReport:
     samples: int
 
 
+def _calibrate_chain(
+    make_run,
+    n_hops: int,
+    *,
+    target_s: float = 0.4,
+    cap: int = 1_000_000,
+    jitter_mult: float = 10.0,
+    min_per_hop_s: float = 20e-9,
+    run_short=None,
+) -> tuple:
+    """Size the long chain for the difference method: grow the calibration
+    chain GEOMETRICALLY until its delta over the short chain clears a
+    jitter floor (``jitter_mult`` × the min-of-3 spread of the short run),
+    then size ``n_long`` for ~``target_s`` of pure hop work (ADVICE r5).
+
+    The old calibration measured one fixed 8× chain: on a tunneled chip
+    both runs are sync-dominated (~100 ms RTT vs µs of hops), so the delta
+    could be jitter-sized or NEGATIVE — clamping the per-hop estimate to
+    20 ns and pegging ``n_long`` at the 1 M cap (minutes of wall-clock for
+    30 repeats). Growing until the delta provably exceeds jitter makes the
+    estimate come from signal, not noise; the cap stays as a last resort
+    for genuinely immeasurable hops.
+
+    ``make_run(n)`` returns a zero-arg callable timing one warmed n-hop
+    chain; pass ``run_short`` when the caller already built the short
+    runner (each build costs a compile + warm). Returns
+    ``(n_long, per_hop_est_s, run_long)`` where ``run_long`` is the
+    already-compiled runner for ``n_long`` when calibration happened to
+    build one (``n_long == n_mid`` — common when jitter forces growth past
+    the work target), else ``None`` and the caller compiles it."""
+    if run_short is None:
+        run_short = make_run(n_hops)
+    shorts = sorted(run_short() for _ in range(3))
+    floor = jitter_mult * (shorts[-1] - shorts[0])
+    n_mid = n_hops * 8
+    while True:
+        run_mid = make_run(n_mid)
+        d = min(run_mid() - run_short() for _ in range(3))
+        if (d > floor and d > 0.0) or n_mid >= cap:
+            break
+        n_mid = min(n_mid * 8, cap)
+    per_hop = max(d / (n_mid - n_hops), min_per_hop_s)
+    n_long = int(min(max(n_mid, target_s / per_hop), cap))
+    return n_long, per_hop, (run_mid if n_long == n_mid else None)
+
+
 def measure_hop_latency(
     mesh,
     *,
@@ -586,24 +632,28 @@ def measure_hop_latency(
         np.asarray(jax.device_get(prog(h)[0, 0, :8]))  # fetch-sync
         return time.perf_counter() - t0
 
-    short = make_prog(n_hops)
-    run(short)  # compile + warm
+    def make_run(n):
+        prog = make_prog(n)
+        run(prog)  # compile + warm
+        return lambda: run(prog)
+
+    # one short runner serves both the calibration and the sampling loop
+    # (each make_run is a fresh compile — seconds each on a tunneled chip)
+    run_short = make_run(n_hops)
     # calibrate the long chain: target ≥ ~0.4 s of pure hop work so the
-    # per-sample delta is far above sync jitter, capped at 1M hops. The
-    # estimate must itself come from a CHAIN DELTA — t_short alone is
-    # sync-dominated on a tunneled chip (~100 ms RTT vs µs of hops), which
-    # would size n_long orders of magnitude too small and leave every
-    # sample pure jitter.
-    mid = make_prog(n_hops * 8)
-    run(mid)  # compile + warm
-    d = min(run(mid) - run(short) for _ in range(3))
-    per_hop_est = max(d / (7 * n_hops), 20e-9)
-    n_long = int(min(max(n_hops * 8, 0.4 / per_hop_est), 1_000_000))
-    long = make_prog(n_long)
-    run(long)  # compile + warm
+    # per-sample delta is far above sync jitter. The estimate must come
+    # from a CHAIN DELTA that provably exceeds the sync jitter floor —
+    # see _calibrate_chain (ADVICE r5: the fixed 8× chain's delta could be
+    # jitter-sized or negative on a tunneled chip, pegging n_long at the
+    # 1M cap).
+    n_long, _, run_long = _calibrate_chain(
+        make_run, n_hops, run_short=run_short
+    )
+    if run_long is None:
+        run_long = make_run(n_long)
     samples_us = np.array(
         [
-            (run(long) - run(short)) / (n_long - n_hops) * 1e6
+            (run_long() - run_short()) / (n_long - n_hops) * 1e6
             for _ in range(repeats)
         ]
     )

@@ -498,6 +498,14 @@ class PipelineEngine:
         trace_path: Optional[str] = None,
         speculate: int = 0,
         spec_ngram: int = 3,
+        max_queue: Optional[int] = None,
+        default_deadline_s: Optional[float] = None,
+        fault_plan=None,
+        fault_retries: int = 3,
+        fault_backoff_s: float = 0.01,
+        retryable_exceptions: tuple = (),
+        snapshot_every_s: Optional[float] = None,
+        snapshot_path: Optional[str] = None,
     ):
         """Build a continuous-batching server over this engine's sharded
         arrays (≙ the reference's persistent ``run_worker_loop`` daemon,
@@ -513,7 +521,15 @@ class PipelineEngine:
         tokens verified K+1 positions per forward, a variable number of
         tokens committed per row per step (``runtime/spec.py``). Greedy
         output stays token-identical; decode tok/s rises with the workload's
-        n-gram predictability."""
+        n-gram predictability.
+
+        Resilience knobs (see ``runtime/server.py``'s module docstring):
+        ``max_queue=`` bounds the submit queue (``QueueFull`` past it),
+        ``default_deadline_s=`` attaches a default per-request deadline,
+        ``fault_plan=``/``fault_retries=``/``fault_backoff_s=``/
+        ``retryable_exceptions=`` configure fault injection and the
+        transient-retry policy, and ``snapshot_every_s=``+``snapshot_path=``
+        arm periodic atomic crash-recovery checkpoints."""
         self._validate_serve()
         from .server import PipelineServer
 
@@ -529,6 +545,14 @@ class PipelineEngine:
             trace_path=trace_path,
             speculate=speculate,
             spec_ngram=spec_ngram,
+            max_queue=max_queue,
+            default_deadline_s=default_deadline_s,
+            fault_plan=fault_plan,
+            fault_retries=fault_retries,
+            fault_backoff_s=fault_backoff_s,
+            retryable_exceptions=retryable_exceptions,
+            snapshot_every_s=snapshot_every_s,
+            snapshot_path=snapshot_path,
         )
 
     def _shared_server(self, prompt_len: int, max_new: int):

@@ -88,6 +88,9 @@ class ReplicatedServer:
         # independent threads of control — a shared file would interleave
         # their spans with no way to attribute them
         trace_path = serve_kwargs.pop("trace_path", None)
+        # auto-snapshots likewise: one directory per replica, or D daemons
+        # would race the same atomic rename
+        snapshot_path = serve_kwargs.pop("snapshot_path", None)
         self.engines: list[PipelineEngine] = []
         self.servers: list[PipelineServer] = []
         for d in range(data_parallel):
@@ -106,6 +109,9 @@ class ReplicatedServer:
                 eng.serve(
                     trace_path=(
                         f"{trace_path}.r{d}" if trace_path else None
+                    ),
+                    snapshot_path=(
+                        f"{snapshot_path}.r{d}" if snapshot_path else None
                     ),
                     **serve_kwargs,
                 )
@@ -260,8 +266,23 @@ class ReplicatedServer:
                 setattr(agg, k, getattr(agg, k) + v)
         return agg
 
+    @property
+    def health(self) -> str:
+        """Router health = the WORST replica state (a degraded replica
+        degrades the endpoint: the router may still route onto it). Feeds
+        the same ``/healthz`` provider slot as a single server's
+        ``health``."""
+        from .server import _HEALTH_SEVERITY
+
+        return max(
+            (s.health for s in self.servers),
+            key=_HEALTH_SEVERITY.__getitem__,
+        )
+
     def close(self) -> None:
-        """Flush every replica's JSONL trace (no-op without trace_path)."""
+        """Shut every replica down (``PipelineServer.close``: submits
+        rejected, queued/in-flight requests failed with ``ServerClosed``,
+        traces flushed). Idempotent."""
         for s in self.servers:
             s.close()
 

@@ -34,6 +34,28 @@ readout); every step records admit/dispatch/apply phase durations;
 the registry (each bump mirrors to a ``server_*_total`` counter). Serve the
 registry over HTTP with ``obs.MetricsServer`` (CLI: ``--metrics-port`` →
 ``/metrics`` Prometheus text, ``/statz`` JSON).
+
+Resilience (the reference's only failure story is the operator restarting
+the chain by hand — here the daemon survives instead):
+
+- **admission control**: ``max_queue=`` bounds the submit queue
+  (``QueueFull`` on overflow), ``deadline_s=`` / ``default_deadline_s=``
+  attaches per-request deadlines — expired-in-queue requests are shed at
+  admit time, expired-in-flight requests are batch-cancelled at the next
+  chunk boundary (one ``serve_cancel_rows`` dispatch per sweep);
+- **failure containment**: a ``runtime/faults.py`` plan injects
+  deterministic faults at named sites; dispatch and log-fetch are wrapped in
+  bounded retry-with-backoff for transient faults, and a persistent failure
+  fails only the affected requests (``Request.error`` + ``RequestFailed``
+  from ``stream()``/``result()``) while the daemon drops to DEGRADED and
+  keeps serving — freed rows re-admit from the queue;
+- **crash recovery**: ``snapshot_every_s=``/``snapshot_path=`` auto-
+  checkpoints the live daemon atomically (tmp+rename ``save_snapshot``);
+  ``restore`` requeues every in-flight request with its already-streamed
+  tokens intact;
+- **health**: a live SERVING/DEGRADED/DRAINING state machine
+  (``health`` property, one-hot ``server_health_state`` gauge, the
+  ``MetricsServer`` 503-on-unhealthy ``/healthz`` source).
 """
 
 from __future__ import annotations
@@ -55,8 +77,43 @@ from ..obs.metrics import DEFAULT_RATE_BUCKETS, REGISTRY, record_shape_key
 from ..obs.trace import TraceWriter
 from ..parallel import serve as serve_ops
 from ..parallel.mesh import PIPE_AXIS
+from .faults import backoff_delays, is_transient
 
 logger = logging.getLogger("llm_sharding_tpu.server")
+
+# -- health states (the live state machine behind /healthz) -----------------
+SERVING = "SERVING"      # admitting and decoding normally
+DEGRADED = "DEGRADED"    # a containment event this window: some requests
+#                          failed, the daemon is still serving the rest
+DRAINING = "DRAINING"    # shutting down: no admits, queued requests failed
+_HEALTH_SEVERITY = {SERVING: 0, DEGRADED: 1, DRAINING: 2}
+
+
+class QueueFull(RuntimeError):
+    """``submit`` rejected: the bounded queue (``max_queue=``) is at
+    capacity. Callers shed load (retry later / another replica) instead of
+    growing an unbounded backlog in front of a saturated device."""
+
+
+class ServerClosed(RuntimeError):
+    """The server was ``close()``d: submits are rejected and queued
+    requests were failed with this error."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed: shed from the queue at admit time, or
+    cancelled at the next chunk boundary if already decoding."""
+
+
+class RequestFailed(RuntimeError):
+    """Raised from ``stream()``/``result()`` for a request that FAILED
+    (``req.error`` holds the cause: containment, deadline, shutdown) —
+    consumers unblock with a typed error instead of spinning on a request
+    that will never finish."""
+
+    def __init__(self, msg: str, request=None):
+        super().__init__(msg)
+        self.request = request
 
 # -- serving telemetry (obs/): process-wide latency spans and gauges --------
 _M_QUEUE_WAIT = REGISTRY.histogram(
@@ -121,6 +178,61 @@ _M_FETCH_FAIL = REGISTRY.counter(
     "Prefetched device-to-host reads that raised (chunk logs, admit tokens)",
 )
 
+# -- resilience telemetry ---------------------------------------------------
+_M_REJECTED = REGISTRY.counter(
+    "server_rejected_total",
+    "Submits rejected at admission control, by reason "
+    "(queue_full = max_queue reached, closed = server shut down)",
+    labels=("reason",),
+)
+_M_DEADLINE = REGISTRY.counter(
+    "server_deadline_expired_total",
+    "Requests whose deadline expired, by where they were caught "
+    "(queued = shed at admit time, in_flight = cancelled at a chunk "
+    "boundary)",
+    labels=("where",),
+)
+_M_RETRIES = REGISTRY.counter(
+    "server_retries_total",
+    "Transient-failure retries of a serving operation, by site",
+    labels=("site",),
+)
+_M_CONTAINED = REGISTRY.counter(
+    "server_failures_contained_total",
+    "Persistent failures contained to their affected requests, by site",
+    labels=("site",),
+)
+_M_SNAPSHOTS = REGISTRY.counter(
+    "server_snapshots_total",
+    "Auto-snapshots written successfully (snapshot_every_s=)",
+)
+_M_SNAPSHOT_FAIL = REGISTRY.counter(
+    "server_snapshot_failures_total",
+    "Auto-snapshot attempts that failed (kept serving; retried next "
+    "interval)",
+)
+# One-hot health over the LIVE servers in the process: the worst (most
+# severe) state across them — a per-server set_state would clobber between
+# dp replicas exactly like the load gauges (see _LIVE_SERVERS above).
+_M_HEALTH = REGISTRY.state_gauge(
+    "server_health_state",
+    "Serving health state machine (worst across live servers): exactly one "
+    "state label is 1",
+    states=(SERVING, DEGRADED, DRAINING),
+)
+
+
+def _update_health_gauge() -> None:
+    """Aggregate health = the worst state across live, open servers; closed
+    servers stop voting (a discarded daemon must not pin DRAINING on the
+    process) unless every server is closed."""
+    states = [
+        s._health for s in list(_LIVE_SERVERS) if not s._closed
+    ]
+    if not states:
+        states = [s._health for s in list(_LIVE_SERVERS)] or [SERVING]
+    _M_HEALTH.set_state(max(states, key=_HEALTH_SEVERITY.__getitem__))
+
 # Admission prompt buckets: each one a compiled serve_admit shape (compiles
 # happen only for buckets actually used; the ladder tops out at 32k so long-
 # context prompts stream through the shared server too — r3 weak #6's cap)
@@ -143,6 +255,7 @@ class Counters:
     requests_submitted: int = 0
     requests_completed: int = 0
     requests_cancelled: int = 0
+    requests_failed: int = 0  # deadline expiry, containment, shutdown
     tokens_generated: int = 0
     admissions: int = 0
     chunks: int = 0
@@ -197,11 +310,38 @@ class _Prefetched:
         if self.error is not None:
             # name the chunk/admission the failed device→host read belonged
             # to — a bare re-raise surfaced "transfer failed" with no way to
-            # tell WHICH of the in-flight logs died
+            # tell WHICH of the in-flight logs died. The original error
+            # rides as __cause__ (faults.is_transient unwraps it, so a
+            # retryable_exceptions match still classifies as transient).
             raise RuntimeError(
                 f"prefetched device read failed for {self.tag}: "
                 f"{self.error!r}"
             ) from self.error
+        return self.value
+
+    def get_retryable(self) -> np.ndarray:
+        """``get``, but a failed prefetch RE-ISSUES the device read from
+        the handle kept on error (a plain ``get`` retry would only re-raise
+        the cached error — the read itself must be retried for the bounded
+        log-fetch retry policy to absorb real transient transfer faults)."""
+        self.event.wait()
+        if self.error is None:
+            return self.value
+        if self.handle is None:
+            raise RuntimeError(
+                f"prefetched device read failed for {self.tag} and the "
+                f"device handle is gone: {self.error!r}"
+            ) from self.error
+        try:
+            self.value = np.asarray(self.handle)
+        except BaseException as e:  # noqa: BLE001 — classified by caller
+            self.error = e
+            _M_FETCH_FAIL.inc()
+            raise RuntimeError(
+                f"device read retry failed for {self.tag}: {e!r}"
+            ) from e
+        self.error = None
+        self.handle = None
         return self.value
 
 
@@ -243,6 +383,8 @@ class _Prefetcher:
                 p.error = e
                 _M_FETCH_FAIL.inc()
                 logger.warning("prefetch failed for %s: %r", p.tag, e)
+                p.event.set()
+                continue  # KEEP the handle: get_retryable re-issues the read
             p.handle = None  # drop the device reference promptly
             p.event.set()
 
@@ -252,13 +394,27 @@ def save_snapshot(snap: dict, path: str) -> None:
     every array, ``meta.json`` for host bookkeeping — no pickling, so a
     snapshot from an untrusted disk cannot execute code on load). bfloat16
     arrays (npz has no native encoding — they silently round-trip as void
-    bytes) ride as uint16 views with a dtype tag in the meta."""
+    bytes) ride as uint16 views with a dtype tag in the meta.
+
+    ATOMIC: everything lands in a temp sibling directory which is renamed
+    into place, so a crash mid-write (the very failure auto-snapshot exists
+    for) can never leave a TORN snapshot — what is at ``path`` is always a
+    complete snapshot. Directory renames cannot replace a non-empty target,
+    so overwriting momentarily parks the previous snapshot at
+    ``path.old.<pid>``; a crash inside that window leaves ``path`` absent
+    but the parked snapshot intact, and ``load_snapshot`` falls back to it
+    — a complete snapshot is recoverable from ``path`` at every instant."""
     import json as _json
     import os
+    import shutil
 
     import ml_dtypes
 
-    os.makedirs(path, exist_ok=True)
+    path = os.path.normpath(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
     arrays: dict = {}
     dtags: dict = {}
 
@@ -300,18 +456,74 @@ def save_snapshot(snap: dict, path: str) -> None:
         "queue": enc_reqs("queue", snap["queue"]),
         "dtype_tags": dtags,
     }
-    np.savez(os.path.join(path, "state.npz"), **arrays)
-    with open(os.path.join(path, "meta.json"), "w") as f:
+    np.savez(os.path.join(tmp, "state.npz"), **arrays)
+    with open(os.path.join(tmp, "state.npz"), "rb") as f:
+        os.fsync(f.fileno())  # data must be durable BEFORE the rename is:
+        # a power loss that persists the rename but not the npz blocks
+        # would leave a well-named torn snapshot the fallback can't detect
+    with open(os.path.join(tmp, "meta.json"), "w") as f:
         _json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_dir(tmp)
+
+    # swap the complete snapshot into place; an existing one steps aside
+    # first (os.rename cannot replace a non-empty directory) and is removed
+    # only after the new snapshot is at ``path``
+    if os.path.isdir(path):
+        old = f"{path}.old.{os.getpid()}"
+        if os.path.isdir(old):
+            shutil.rmtree(old)
+        os.rename(path, old)
+        os.rename(tmp, path)
+        _fsync_dir(os.path.dirname(os.path.abspath(path)))
+        shutil.rmtree(old, ignore_errors=True)
+    else:
+        os.rename(tmp, path)
+        _fsync_dir(os.path.dirname(os.path.abspath(path)))
+
+
+def _fsync_dir(path: str) -> None:
+    """Best-effort directory fsync: makes renames durable across power
+    loss. Some filesystems refuse O_DIRECTORY fsync — skip, don't fail."""
+    import os
+
+    try:
+        fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def load_snapshot(path: str) -> dict:
-    """Read a ``save_snapshot`` directory back into ``restore`` input."""
+    """Read a ``save_snapshot`` directory back into ``restore`` input.
+
+    Falls back to the newest ``path.old.<pid>`` sibling when ``path``
+    itself is missing — the crash-inside-the-rename-window case (see
+    ``save_snapshot``): the previous complete snapshot was parked aside
+    and the process died before the new one swapped in."""
+    import glob
     import json as _json
     import os
 
     import ml_dtypes
 
+    if not os.path.exists(os.path.join(path, "meta.json")):
+        parked = sorted(
+            glob.glob(f"{os.path.normpath(path)}.old.*"),
+            key=os.path.getmtime,
+        )
+        if parked:
+            logger.warning(
+                "snapshot %s missing; recovering the parked previous "
+                "snapshot %s (the writer died mid-swap)", path, parked[-1],
+            )
+            path = parked[-1]
     with open(os.path.join(path, "meta.json")) as f:
         meta = _json.load(f)
     dtags = meta.get("dtype_tags", {})
@@ -370,6 +582,8 @@ class Request:
         "embeds", "prefix", "submitted_at", "started_at", "finished_at",
         "first_token_at", "last_token_at",  # latency spans (TTFT/inter-token)
         "spec_k",  # per-request adaptive draft-width controller (spec mode)
+        "deadline_at",  # absolute (perf_counter) deadline; None = none
+        "error",  # why the request FAILED (deadline/containment/shutdown)
         "__weakref__",  # the dp router tracks request→replica ownership
     )
 
@@ -385,6 +599,7 @@ class Request:
         stop: tuple = (),
         embeds: Optional[np.ndarray] = None,  # [S, H] privacy entry
         prefix: Optional["PrefixHandle"] = None,  # shared-prefix KV handle
+        deadline_s: Optional[float] = None,  # relative deadline at submit
     ):
         self.id = rid
         self.prompt = prompt
@@ -404,7 +619,11 @@ class Request:
         self.done = False
         self.row: Optional[int] = None
         self.spec_k = None  # set by a speculative server at submit
+        self.error: Optional[BaseException] = None
         self.submitted_at = time.perf_counter()
+        self.deadline_at = (
+            None if deadline_s is None else self.submitted_at + deadline_s
+        )
         self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self.first_token_at: Optional[float] = None
@@ -454,6 +673,14 @@ class PipelineServer:
         trace_path: Optional[str] = None,
         speculate: int = 0,
         spec_ngram: int = 3,
+        max_queue: Optional[int] = None,
+        default_deadline_s: Optional[float] = None,
+        fault_plan=None,  # runtime.faults.FaultPlan (tests/chaos/bench)
+        fault_retries: int = 3,
+        fault_backoff_s: float = 0.01,
+        retryable_exceptions: tuple = (),
+        snapshot_every_s: Optional[float] = None,
+        snapshot_path: Optional[str] = None,
     ):
         self.engine = engine
         self.cfg = engine.cfg
@@ -514,12 +741,37 @@ class PipelineServer:
             )
         self.speculate = int(speculate)
         self.spec_ngram = int(spec_ngram)
+        # -- resilience knobs (see module docstring) -----------------------
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if default_deadline_s is not None and default_deadline_s <= 0:
+            raise ValueError(
+                f"default_deadline_s must be > 0, got {default_deadline_s}"
+            )
+        self.max_queue = max_queue
+        self.default_deadline_s = default_deadline_s
+        self._fault_plan = fault_plan
+        if fault_retries < 0:
+            raise ValueError(f"fault_retries must be >= 0, got {fault_retries}")
+        self._fault_retries = int(fault_retries)
+        self._fault_backoff_s = float(fault_backoff_s)
+        self._retryable = tuple(retryable_exceptions)
+        self._health = SERVING
+        self._closed = False
+        self._step_contained = False  # a containment event this step
+        self._snapshot_every_s: Optional[float] = None
+        self._snapshot_path: Optional[str] = None
+        self._last_snapshot_at = time.perf_counter()
+        if snapshot_every_s is not None or snapshot_path is not None:
+            self.enable_auto_snapshot(snapshot_path, snapshot_every_s)
         self.counters = Counters()
         # optional JSONL span trace (obs/trace.py). Deliberately NOT part of
         # serve_kwargs in snapshot(): an observability knob, not serving
         # state — the checkpoint format is unchanged.
         self._trace = TraceWriter(trace_path) if trace_path else None
         _LIVE_SERVERS.add(self)  # load gauges sum over live servers
+        _update_health_gauge()  # one-hot shows SERVING from birth, not
+        # only after the first health transition
 
         from ..ops.quant import QTensor
 
@@ -597,6 +849,7 @@ class PipelineServer:
         top_p: Optional[float] = None,
         stop=None,  # iterable of stop STRINGS (host-side, needs a tokenizer)
         prefix: Optional[PrefixHandle] = None,
+        deadline_s: Optional[float] = None,
     ) -> Request:
         """Enqueue a request (≙ ``receive_user_request``, admission happens
         on the next ``step``). ``temperature > 0`` samples with this
@@ -609,8 +862,17 @@ class PipelineServer:
         With ``prefix`` (a ``prefill_prefix`` handle), ``prompt_ids`` is the
         SUFFIX only — generation is token-exact vs submitting
         ``prefix_ids + prompt_ids`` whole, but admission skips the prefix's
-        prefill. Only same-handle requests co-admit into one slot batch."""
+        prefill. Only same-handle requests co-admit into one slot batch.
+
+        ``deadline_s`` (default: the server's ``default_deadline_s``) bounds
+        the request's whole life from submission: still queued past it → shed
+        at admit time; mid-decode past it → cancelled at the next chunk
+        boundary. Either way the request FAILS (``stream()``/``result()``
+        raise ``RequestFailed`` whose cause is ``DeadlineExceeded``).
+        Raises ``QueueFull`` when ``max_queue`` is reached and
+        ``ServerClosed`` after ``close()``."""
         top_k, top_p = self._resolve_filters(top_k, top_p)
+        deadline_s = self._resolve_deadline(deadline_s)
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prefix is None:
             self._validate_budget(
@@ -640,10 +902,11 @@ class PipelineServer:
                 )
         stop = self._validate_stop(stop)
         with self._mutex:
+            self._check_admission()
             req = Request(
                 self._new_id(), prompt, max_new_tokens,
                 temperature=temperature, seed=seed, top_k=top_k, top_p=top_p,
-                stop=stop, prefix=prefix,
+                stop=stop, prefix=prefix, deadline_s=deadline_s,
             )
             if self.speculate:
                 from .spec import AdaptiveK
@@ -717,6 +980,8 @@ class PipelineServer:
         requests hold prefix handles (device-bound KV — let them admit
         first, or resubmit them after restore)."""
         with self._mutex:
+            if self._closed:
+                raise ServerClosed("cannot snapshot a closed server")
             if self._admitting_rows:
                 raise RuntimeError(
                     "snapshot mid-chunked-admission is not supported — "
@@ -746,6 +1011,12 @@ class PipelineServer:
                     "tokens": list(r.tokens),
                     "done": r.done,
                     "row": r.row,
+                    # deadlines are stored as TIME REMAINING: perf_counter
+                    # epochs don't survive a process, the budget does
+                    "deadline_left": (
+                        None if r.deadline_at is None
+                        else max(r.deadline_at - time.perf_counter(), 0.0)
+                    ),
                 }
                 if r.prefix is not None:
                     # padded-prefix column count: restore rebuilds the
@@ -765,6 +1036,8 @@ class PipelineServer:
                     pipeline_depth=self.pipeline_depth,
                     speculate=self.speculate,
                     spec_ngram=self.spec_ngram,
+                    max_queue=self.max_queue,
+                    default_deadline_s=self.default_deadline_s,
                 ),
                 "state": jax.tree.map(np.asarray, self.state._asdict()),
                 "m": self._m,
@@ -857,6 +1130,14 @@ class PipelineServer:
             r.tokens = list(d["tokens"])
             r.done = d["done"]
             r.row = d["row"]
+            if d.get("deadline_left") is not None:
+                # re-arm from the remaining budget at snapshot time — the
+                # downtime between crash and restore does not count against
+                # the request (the client's wait does, but that clock is
+                # unknowable here)
+                r.deadline_at = time.perf_counter() + float(
+                    d["deadline_left"]
+                )
             if srv.speculate:
                 from .spec import AdaptiveK
 
@@ -910,6 +1191,7 @@ class PipelineServer:
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
         stop=None,
+        deadline_s: Optional[float] = None,
     ) -> Request:
         """Enqueue a request that enters as EMBEDDINGS — the privacy entry
         (≙ the reference's request-injection channel: an embedding-capable
@@ -920,6 +1202,7 @@ class PipelineServer:
         token-exactly vs ``submit(ids, ...)``. Embeds requests always use
         one-shot admission (chunked prefill is an ids-path optimization)."""
         top_k, top_p = self._resolve_filters(top_k, top_p)
+        deadline_s = self._resolve_deadline(deadline_s)
         h = np.asarray(prompt_embeds, self._act_dtype)
         if h.ndim == 3:
             if h.shape[0] != 1:
@@ -938,10 +1221,11 @@ class PipelineServer:
         )
         stop = self._validate_stop(stop)
         with self._mutex:
+            self._check_admission()
             req = Request(
                 self._new_id(), np.zeros((0,), np.int32), max_new_tokens,
                 temperature=temperature, seed=seed, top_k=top_k, top_p=top_p,
-                stop=stop, embeds=h,
+                stop=stop, embeds=h, deadline_s=deadline_s,
             )
             if self.speculate:
                 from .spec import AdaptiveK
@@ -978,9 +1262,20 @@ class PipelineServer:
         With ``speculate=K`` the decode chunk is replaced by per-slot
         ``serve_verify`` traversals (``_spec_step``): each commits a
         VARIABLE number of tokens per row and its log is drained within the
-        same step — the next step's drafts need the committed ids."""
+        same step — the next step's drafts need the committed ids.
+
+        Resilience: a deadline sweep runs first (expired queued requests
+        shed, expired in-flight rows batch-cancelled); dispatch and log
+        fetch retry transient failures with bounded backoff; a persistent
+        failure is contained to its affected requests (health drops to
+        DEGRADED) and the daemon keeps stepping — a subsequent clean
+        productive step restores SERVING. With auto-snapshot armed the step
+        ends by checkpointing once per interval. A closed server no-ops."""
         with self._mutex:
-            progressed = False
+            if self._closed:
+                return False
+            self._step_contained = False
+            progressed = self._shed_expired()
             if self._queue and self._free_slots():
                 # admission needs accurate mirrors → flush outstanding logs
                 # first. Gated on the (possibly stale) mirror view showing a
@@ -1006,39 +1301,7 @@ class PipelineServer:
                 t0 = time.perf_counter()
                 applied = self._drain(0)  # next drafts need these commits
             elif self._any_active():
-                t0 = time.perf_counter()
-                cycles = self.num_stages * self.chunk_cycles
-                record_shape_key(
-                    "serve_chunk",
-                    (self.num_stages, self.batch_per_slot, self.capacity,
-                     cycles, self._sampling, self._filtering, self.tp),
-                )
-                self.state, log = serve_ops.serve_chunk(
-                    self.cfg,
-                    self.mesh,
-                    self.engine.stage_layers,
-                    self.engine.layer_masks,
-                    self.engine.head_params,
-                    self.state,
-                    self.num_stages,
-                    cycles,
-                    self._sampling,
-                    self._filtering,
-                    tp=self.tp,
-                )
-                self._pending.append(
-                    ("chunk",
-                     self._prefetcher.fetch(log, tag=f"chunk m0={self._m}"),
-                     self._m)
-                )
-                dt_dispatch = time.perf_counter() - t0
-                _M_STEP_PHASE.labels(phase="dispatch").observe(dt_dispatch)
-                if self._trace:
-                    self._trace.emit(
-                        "chunk", dur_s=dt_dispatch, m0=self._m, cycles=cycles,
-                    )
-                self._m += cycles
-                self.counters.inc("chunks")
+                self._dispatch_chunk()
                 progressed = True
                 t0 = time.perf_counter()
                 applied = self._drain(self.pipeline_depth)
@@ -1051,19 +1314,184 @@ class PipelineServer:
                 if self._trace:
                     self._trace.emit("apply", dur_s=dt_apply, applied=applied)
                 _update_load_gauges()
-            return progressed
+            snap_due = self._capture_autosnapshot()
+            if (
+                self._health == DEGRADED
+                and not self._step_contained
+                and (
+                    progressed or applied
+                    # idle counts as clean too: nothing left to fail, so a
+                    # drained daemon must not report 503 forever (a
+                    # health-gated balancer would never send the traffic
+                    # whose success would otherwise be the recovery signal)
+                    or not (
+                        self._queue or self._any_active() or self._pending
+                    )
+                )
+            ):
+                # a clean step after containment: recovered
+                self._set_health(SERVING)
+        # the npz serialization + atomic rename of a potentially multi-GB
+        # state runs OUTSIDE the mutex: only this pump thread pays the
+        # write; stream()/submit() consumers on other threads stay live
+        if snap_due is not None:
+            self._write_autosnapshot(snap_due)
+        return progressed
+
+    def _dispatch_chunk(self) -> None:
+        """Dispatch one interleaved decode chunk, retrying transient
+        dispatch failures; a persistent failure is contained (the rows this
+        chunk was driving fail, the daemon survives)."""
+        t0 = time.perf_counter()
+        cycles = self.num_stages * self.chunk_cycles
+        record_shape_key(
+            "serve_chunk",
+            (self.num_stages, self.batch_per_slot, self.capacity,
+             cycles, self._sampling, self._filtering, self.tp),
+        )
+
+        def do_chunk():
+            self._fault_check("chunk_dispatch")
+            return serve_ops.serve_chunk(
+                self.cfg,
+                self.mesh,
+                self.engine.stage_layers,
+                self.engine.layer_masks,
+                self.engine.head_params,
+                self.state,
+                self.num_stages,
+                cycles,
+                self._sampling,
+                self._filtering,
+                tp=self.tp,
+            )
+
+        try:
+            self.state, log = self._retry(
+                "chunk_dispatch", do_chunk, real_ok=False
+            )
+        except Exception as e:  # noqa: BLE001 — persistent: contain it
+            self._contain_dispatch_failure("chunk_dispatch", e)
+            return
+        self._pending.append(
+            ("chunk",
+             self._prefetcher.fetch(log, tag=f"chunk m0={self._m}"),
+             self._m)
+        )
+        dt_dispatch = time.perf_counter() - t0
+        _M_STEP_PHASE.labels(phase="dispatch").observe(dt_dispatch)
+        if self._trace:
+            self._trace.emit(
+                "chunk", dur_s=dt_dispatch, m0=self._m, cycles=cycles,
+            )
+        self._m += cycles
+        self.counters.inc("chunks")
 
     def run_until_idle(self) -> None:
         """Drain the queue and all in-flight requests (the test/batch mode;
         a real deployment calls ``step`` from its own loop forever)."""
-        while self._queue or self._any_active() or self._pending:
+        while not self._closed and (
+            self._queue or self._any_active() or self._pending
+        ):
             self.step()
 
+    @property
+    def health(self) -> str:
+        """The live health state: ``SERVING`` (normal), ``DEGRADED`` (a
+        recent failure was contained — some requests failed, the daemon is
+        still serving; clears on the next clean productive step) or
+        ``DRAINING`` (``close()`` ran; no admits). ``obs.MetricsServer``
+        turns anything but SERVING into a 503 ``/healthz`` so load
+        balancers rotate the daemon out instead of timing out on it."""
+        return self._health
+
+    def _set_health(self, state: str) -> None:
+        if state != self._health:
+            logger.warning("health %s -> %s", self._health, state)
+            self._health = state
+        _update_health_gauge()
+
+    def enable_auto_snapshot(
+        self, path: Optional[str], every_s: Optional[float]
+    ) -> None:
+        """Arm (or disarm, with two Nones) periodic crash-recovery
+        checkpoints: at most one atomic ``save_snapshot`` to ``path`` per
+        ``every_s`` seconds, taken at the end of ``step()`` (``0`` = every
+        step). Also the post-``restore`` hook the CLI uses to re-arm
+        snapshotting on a revived daemon — like ``trace_path``, snapshot
+        destinations are ops knobs and deliberately NOT serving state, so
+        they never ride in the checkpoint's ``serve_kwargs``."""
+        if (path is None) != (every_s is None):
+            raise ValueError(
+                "snapshot_path and snapshot_every_s go together (got "
+                f"path={path!r}, every_s={every_s!r})"
+            )
+        if every_s is not None and every_s < 0:
+            raise ValueError(f"snapshot_every_s must be >= 0, got {every_s}")
+        self._snapshot_path = path
+        self._snapshot_every_s = every_s
+        self._last_snapshot_at = time.perf_counter()
+
+    def result(self, req: Request) -> list:
+        """Pump the server until ``req`` finishes; return its generated
+        token ids. Raises ``RequestFailed`` (cause chained: deadline,
+        containment, shutdown) instead of spinning on a request that can
+        never finish."""
+        while not req.done:
+            progressed = self.step()
+            if req.done:
+                break
+            if not progressed and not (
+                self._queue or self._any_active() or self._pending
+            ):
+                # nothing left to pump yet the request cannot finish (the
+                # server closed under us, or the request belongs elsewhere)
+                if req.error is None:
+                    req.error = ServerClosed(
+                        "server went idle with the request unfinished"
+                    )
+                req.done = True
+                break
+        if req.error is not None:
+            raise RequestFailed(
+                f"request {req.id} failed: {req.error}", req
+            ) from req.error
+        return list(req.tokens)
+
     def close(self) -> None:
-        """Flush and close the JSONL trace (no-op without ``trace_path``).
-        The server remains usable; further spans are simply dropped."""
-        if self._trace is not None:
-            self._trace.close()
+        """REAL shutdown, idempotent: stop accepting submits, fail every
+        queued request with ``ServerClosed`` (their ``stream()``/
+        ``result()`` consumers unblock with ``RequestFailed`` instead of
+        pumping forever), stop in-flight rows on device and fail their
+        requests too, drop un-applied logs, flush and close the JSONL
+        trace. Health goes DRAINING and ``step()`` becomes a no-op."""
+        with self._mutex:
+            if self._closed:
+                return
+            self._closed = True
+            err = ServerClosed("server closed")
+            for r in list(self._queue):
+                self._fail_request(r, err)
+            self._queue.clear()
+            victims = [
+                (i, r) for i, r in enumerate(self._rows)
+                if r is not None and not r.done
+            ]
+            if victims:
+                try:
+                    self._cancel_rows([i for i, _ in victims])
+                except Exception:  # noqa: BLE001 — the device may already
+                    # be unusable mid-crash; the host teardown still runs
+                    logger.exception("close: cancel dispatch failed")
+                for _, r in victims:
+                    self._fail_request(r, err)
+            self._pending.clear()
+            self._admitting_rows.clear()
+            self._set_health(DRAINING)
+            _update_load_gauges()
+            if self._trace is not None:
+                self._trace.close()
+        logger.info("server closed")
 
     def cancel(self, req: Request) -> bool:
         """Cancel a queued or in-flight request (a capability the reference
@@ -1104,9 +1532,11 @@ class PipelineServer:
         return True
 
     def _cancel_rows(self, rows: list) -> None:
-        mask = np.zeros((self.num_stages * self.batch_per_slot,), bool)
-        mask[rows] = True
-        self.state = serve_ops.serve_cancel_rows(self.state, jnp.asarray(mask))
+        # one batched dispatch no matter how many rows a cancel, deadline
+        # sweep or containment event stops this step
+        self.state = serve_ops.cancel_rows_batched(
+            self.state, rows, self.num_stages * self.batch_per_slot
+        )
 
     def stream(self, req: Request) -> Iterator[int]:
         """Yield ``req``'s generated token ids as they are produced, pumping
@@ -1117,16 +1547,26 @@ class PipelineServer:
         ``req.tokens`` and (on a stop-sequence hit) truncates them within one
         locked step, so a consumer on another thread observes either the
         pre-extend or the post-truncate state — never tokens past a stop
-        that later vanish."""
+        that later vanish.
+
+        A request that FAILED (deadline expiry, containment, server
+        shutdown) raises ``RequestFailed`` after its partial tokens have
+        been yielded — the consumer unblocks with the cause instead of
+        pumping a dead request forever."""
         idx = 0
         while True:
             with self._mutex:
                 batch = req.tokens[idx:]
                 done = req.done
+                error = req.error
             for t in batch:
                 yield t
             idx += len(batch)
             if done:
+                if error is not None:
+                    raise RequestFailed(
+                        f"request {req.id} failed: {error}", req
+                    ) from error
                 return
             self.step()
 
@@ -1147,6 +1587,255 @@ class PipelineServer:
         if top_k < 0:
             raise ValueError(f"top_k must be >= 0, got {top_k}")
         return top_k, top_p
+
+    def _resolve_deadline(
+        self, deadline_s: Optional[float]
+    ) -> Optional[float]:
+        """Per-request deadline resolved against the server default, same
+        validation on every submit path."""
+        if deadline_s is None:
+            return self.default_deadline_s
+        deadline_s = float(deadline_s)
+        if deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        return deadline_s
+
+    def _check_admission(self) -> None:
+        """Backpressure gate on every submit path (called under the mutex):
+        explicit typed rejection beats an unbounded queue in front of a
+        saturated device."""
+        if self._closed:
+            _M_REJECTED.labels(reason="closed").inc()
+            raise ServerClosed("server is closed; submit rejected")
+        if self.max_queue is not None and len(self._queue) >= self.max_queue:
+            _M_REJECTED.labels(reason="queue_full").inc()
+            raise QueueFull(
+                f"submit queue is full ({len(self._queue)} >= "
+                f"max_queue={self.max_queue}); shed load or retry later"
+            )
+
+    # ------------------------------------------------- resilience internals
+
+    def _fault_check(self, site: str, key=None) -> None:
+        if self._fault_plan is not None:
+            self._fault_plan.check(site, key=key)
+
+    def _retry(self, site: str, fn, real_ok: bool = True):
+        """Run ``fn``, absorbing transient failures (injected
+        ``TransientFault``s plus any constructor-registered
+        ``retryable_exceptions``) with bounded exponential backoff. The
+        final failure — or any non-transient one — propagates so the caller
+        can contain it.
+
+        ``real_ok=False`` restricts retries to INJECTED faults (which raise
+        before the wrapped call runs): the decode/admit dispatch sites pass
+        it because the serve programs DONATE their input ``ServeState`` —
+        re-invoking after a real mid-call failure would replay deleted
+        buffers and poison the daemon. Registered real exceptions stay
+        retryable where the operation is re-issuable: log fetch
+        (``get_retryable`` re-reads from the kept handle) and snapshot
+        capture."""
+        delays = backoff_delays(self._fault_retries, self._fault_backoff_s)
+        retryable = self._retryable if real_ok else ()
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except Exception as e:  # noqa: BLE001 — classified right below
+                if attempt >= self._fault_retries or not is_transient(
+                    e, retryable
+                ):
+                    raise
+                _M_RETRIES.labels(site=site).inc()
+                logger.warning(
+                    "transient failure at %s (attempt %d/%d): %r",
+                    site, attempt + 1, self._fault_retries, e,
+                )
+                if delays[attempt]:
+                    time.sleep(delays[attempt])
+                attempt += 1
+
+    def _fail_request(self, req: Request, err: BaseException) -> None:
+        """Terminal request failure: record the cause, free the slot row if
+        held, and unblock consumers (``stream``/``result`` raise
+        ``RequestFailed`` carrying ``err`` as the cause)."""
+        req.error = err
+        req.done = True
+        req.finished_at = time.perf_counter()
+        if req.row is not None and self._rows[req.row] is req:
+            self._rows[req.row] = None
+        self.counters.inc("requests_failed")
+
+    def _contain_rows(self, site: str, victims: list, err) -> None:
+        """Contain a persistent failure to exactly ``victims`` (row, req)
+        pairs: stop their device rows with one batched cancel, fail their
+        requests, drop to DEGRADED. Every other slot keeps decoding and the
+        freed rows re-admit from the queue on the next step."""
+        self._step_contained = True
+        self._set_health(DEGRADED)
+        _M_CONTAINED.labels(site=site).inc()
+        victims = [
+            (row, req) for row, req in victims
+            if self._rows[row] is req and not req.done
+        ]
+        rows = [row for row, _ in victims]
+        if rows:
+            try:
+                self._cancel_rows(rows)
+            except Exception:  # noqa: BLE001 — the cancel dispatch itself
+                # failed: the requests are still failed host-side; their
+                # device rows run to budget exhaustion and then free
+                logger.exception("containment cancel failed for rows %s",
+                                 rows)
+        for _, req in victims:
+            self._fail_request(req, err)
+        _update_load_gauges()
+        logger.warning(
+            "contained %s failure (%r): failed request(s) %s",
+            site, err, [req.id for _, req in victims],
+        )
+
+    def _contain_admit_failure(self, batch: list, err) -> None:
+        """An admission dispatch failed past retries: fail exactly that
+        batch. The slot never armed on device (only a completed
+        admit/finish dispatch flips its rows live), so its rows stay parked
+        done and simply re-admit other requests later; the host mirrors the
+        batch had already claimed are rolled back."""
+        self._step_contained = True
+        self._set_health(DEGRADED)
+        _M_CONTAINED.labels(site="admit_dispatch").inc()
+        for r in batch:
+            if r.row is not None:
+                self._admitting_rows.discard(r.row)
+                self._mirror_len[r.row] = 0
+                self._mirror_budget[r.row] = 0
+                self._mirror_cachedelta[r.row] = 0
+            self._fail_request(r, err)
+        _update_load_gauges()
+        logger.warning(
+            "contained admit failure (%r): failed request(s) %s",
+            err, [r.id for r in batch],
+        )
+
+    def _contain_dispatch_failure(self, site: str, err) -> None:
+        """A decode dispatch failed past retries. Resync the host mirrors
+        from every log already fetched (the last applied state is the
+        truth), then fail the rows this dispatch was driving; queued
+        requests re-admit into the freed slots next step."""
+        self._drain(0)
+        victims = [
+            (i, r) for i, r in enumerate(self._rows)
+            if r is not None and not r.done
+            and i not in self._admitting_rows
+        ]
+        self._contain_rows(site, victims, err)
+
+    def _contain_lost_log(self, entry, err) -> None:
+        """A prefetched device read was lost past retries. Fail the requests
+        whose tokens it carried: the admit/spec entries name them; a chunk
+        log's per-row attribution died with the log, so every row live for
+        that chunk is affected."""
+        kind = entry[0]
+        if kind == "admit":
+            victims = list(entry[2])
+        elif kind == "spec":
+            victims = [(row, req) for row, req, _, _ in entry[2]]
+        else:
+            victims = [
+                (i, r) for i, r in enumerate(self._rows)
+                if r is not None and not r.done
+                and i not in self._admitting_rows
+            ]
+        self._contain_rows("log_fetch", victims, err)
+
+    def _shed_expired(self) -> bool:
+        """Deadline sweep, start of every step: expired queued requests are
+        shed before they ever cost a prefill; expired in-flight rows are
+        stopped with ONE batched cancel dispatch at this chunk boundary.
+        Both fail with ``DeadlineExceeded``."""
+        now = time.perf_counter()
+        shed = False
+        if self._queue and any(
+            r.deadline_at is not None and now >= r.deadline_at
+            for r in self._queue
+        ):
+            keep: collections.deque = collections.deque()
+            for r in self._queue:
+                if r.deadline_at is not None and now >= r.deadline_at:
+                    _M_DEADLINE.labels(where="queued").inc()
+                    self._fail_request(r, DeadlineExceeded(
+                        f"request {r.id} expired after "
+                        f"{now - r.submitted_at:.3f}s in queue"
+                    ))
+                    shed = True
+                else:
+                    keep.append(r)
+            self._queue = keep
+        expired = [
+            (i, r) for i, r in enumerate(self._rows)
+            if r is not None and not r.done
+            and r.deadline_at is not None and now >= r.deadline_at
+            and i not in self._admitting_rows
+        ]
+        if expired:
+            try:
+                self._cancel_rows([i for i, _ in expired])
+            except Exception:  # noqa: BLE001 — a wedged device exactly when
+                # requests blow deadlines must not kill the sweep: the
+                # requests still fail host-side and the device rows run to
+                # budget exhaustion and free (same guard as containment)
+                logger.exception(
+                    "deadline cancel dispatch failed for rows %s",
+                    [i for i, _ in expired],
+                )
+            for i, r in expired:
+                _M_DEADLINE.labels(where="in_flight").inc()
+                self._fail_request(r, DeadlineExceeded(
+                    f"request {r.id} expired mid-decode "
+                    f"({len(r.tokens)}/{r.max_new} tokens)"
+                ))
+            shed = True
+        if shed:
+            _update_load_gauges()
+        return shed
+
+    def _capture_autosnapshot(self) -> Optional[dict]:
+        """End-of-step crash-recovery checkpoint CAPTURE (under the step's
+        mutex), at most once per armed interval — the disk write happens
+        back in ``step()`` after the lock drops. Failures (an injected
+        ``snapshot_write`` fault, a snapshot-refusing state like queued
+        prefix requests) are counted and retried next interval — a broken
+        snapshot source must never stop serving. The interval clock
+        advances on failure too, so a persistently failing capture costs
+        one attempt per interval, not one per step."""
+        if self._snapshot_every_s is None:
+            return None
+        now = time.perf_counter()
+        if now - self._last_snapshot_at < self._snapshot_every_s:
+            return None
+        self._last_snapshot_at = now
+
+        def do_snap():
+            self._fault_check("snapshot_write")
+            return self.snapshot()
+
+        try:
+            return self._retry("snapshot_write", do_snap)
+        except Exception as e:  # noqa: BLE001 — kept serving
+            _M_SNAPSHOT_FAIL.inc()
+            logger.warning("auto-snapshot capture failed: %r", e)
+            return None
+
+    def _write_autosnapshot(self, snap: dict) -> None:
+        """The disk half of auto-snapshot (atomic tmp+rename), lock-free: a
+        full disk is counted, never fatal."""
+        try:
+            save_snapshot(snap, self._snapshot_path)
+        except Exception as e:  # noqa: BLE001 — kept serving
+            _M_SNAPSHOT_FAIL.inc()
+            logger.warning("auto-snapshot write failed: %r", e)
+        else:
+            _M_SNAPSHOTS.inc()
 
     def _validate_budget(
         self, bucket: int, max_new: int, *, chunkable: bool
@@ -1306,12 +1995,20 @@ class PipelineServer:
                     - (pfx_n + r.prompt_len)
                 )
             serve_ops.ADMIT_BUCKET_USED.labels(bucket=str(bucket)).inc()
-            if not is_emb and pfx is None and self._chunked(bucket):
-                self._admit_chunked(
-                    slot, prompts, plen, row_valid, max_new, seeds, temps,
-                    topks, topps,
-                )
-            else:
+
+            def do_admit(
+                slot=slot, bucket=bucket, batch=batch, is_emb=is_emb,
+                pfx=pfx, prompts=prompts, embeds=embeds, plen=plen,
+                row_valid=row_valid, max_new=max_new, seeds=seeds,
+                temps=temps, topks=topks, topps=topps,
+            ):
+                self._fault_check("admit_dispatch")
+                if not is_emb and pfx is None and self._chunked(bucket):
+                    self._admit_chunked(
+                        slot, prompts, plen, row_valid, max_new, seeds,
+                        temps, topks, topps,
+                    )
+                    return
                 record_shape_key(
                     "serve_admit",
                     (self.num_stages, Bs, self.capacity, bucket, is_emb,
@@ -1359,6 +2056,15 @@ class PipelineServer:
                         [(r.row, r) for r in batch],
                     )
                 )
+
+            try:
+                self._retry("admit_dispatch", do_admit, real_ok=False)
+            except Exception as e:  # noqa: BLE001 — contain: fail exactly
+                # this batch; the slot stays parked done on device (it is
+                # only armed by a successful admit/finish dispatch), other
+                # slots keep decoding and later queue entries still admit
+                self._contain_admit_failure(batch, e)
+                continue
             self.counters.inc("admissions")
             admitted = True
             if self._trace:
@@ -1511,23 +2217,35 @@ class PipelineServer:
                 (self.num_stages, Bs, self.capacity, K, self._sampling,
                  self._filtering, self.tp),
             )
-            self.state, log = serve_ops.serve_verify(
-                self.cfg,
-                self.mesh,
-                self.engine.stage_layers,
-                self.engine.layer_masks,
-                self.engine.head_params,
-                self.state,
-                jnp.asarray(draft),
-                jnp.asarray(draft_len),
-                jnp.asarray(slot, jnp.int32),
-                jnp.asarray(cache_delta),
-                self.num_stages,
-                K,
-                self._sampling,
-                self._filtering,
-                tp=self.tp,
-            )
+            def do_verify(slot=slot, draft=draft, draft_len=draft_len,
+                          cache_delta=cache_delta):
+                self._fault_check("chunk_dispatch")
+                return serve_ops.serve_verify(
+                    self.cfg,
+                    self.mesh,
+                    self.engine.stage_layers,
+                    self.engine.layer_masks,
+                    self.engine.head_params,
+                    self.state,
+                    jnp.asarray(draft),
+                    jnp.asarray(draft_len),
+                    jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(cache_delta),
+                    self.num_stages,
+                    K,
+                    self._sampling,
+                    self._filtering,
+                    tp=self.tp,
+                )
+
+            try:
+                self.state, log = self._retry(
+                    "chunk_dispatch", do_verify, real_ok=False
+                )
+            except Exception as e:  # noqa: BLE001 — contain to this slot's
+                # rows; other slots' verifies keep dispatching
+                self._contain_rows("chunk_dispatch", list(live), e)
+                continue
             self._pending.append(
                 (
                     "spec",
@@ -1580,21 +2298,35 @@ class PipelineServer:
         ``max_pending=1`` is the steady-state pipeline depth (the newest
         chunk's log stays in flight while its chunk executes);
         ``max_pending=0`` is a full flush (before admission decisions and at
-        drain time). Returns the number of entries applied."""
+        drain time). Returns the number of entries applied.
+
+        Fetch failures retry for transient faults; a log lost past retries
+        fails the requests whose tokens it carried (``_contain_lost_log``)
+        and draining continues with the next entry — one poisoned read
+        never wedges the apply path."""
         applied = 0
         while len(self._pending) > max_pending:
             entry = self._pending.popleft()
             applied += 1
+            try:
+                value = self._retry(
+                    "log_fetch",
+                    lambda e=entry: (
+                        self._fault_check("log_fetch"), e[1].get_retryable()
+                    )[1],
+                )
+            except Exception as err:  # noqa: BLE001 — the log is lost
+                self._contain_lost_log(entry, err)
+                continue
             if entry[0] == "chunk":
-                self._apply_log(entry[1].get(), entry[2])
+                self._apply_log(value, entry[2])
             elif entry[0] == "spec":
-                self._apply_spec(entry[1].get(), entry[2])
+                self._apply_spec(value, entry[2])
             else:  # "admit": per-row first tokens from serve_admit
-                tok0 = entry[1].get()
                 for i, (row, req) in enumerate(entry[2]):
                     if req.done or self._rows[row] is not req:
                         continue  # cancelled between dispatch and drain
-                    self._apply_token(row, req, int(tok0[i]))
+                    self._apply_token(row, req, int(value[i]))
         return applied
 
     def _apply_log(self, log: np.ndarray, m0: int) -> None:
@@ -1620,7 +2352,21 @@ class PipelineServer:
         """One committed token → request buffer + mirrors + completion,
         recording the request's latency spans (TTFT on the first token,
         inter-arrival on every subsequent one, queue-wait + e2e + tok/s at
-        completion) into the metrics registry."""
+        completion) into the metrics registry.
+
+        The per-request fault site lives here: a permanent
+        ``request_apply`` fault keyed to this request's id fails exactly
+        this request (its row frees, co-resident rows keep decoding) —
+        the poisoned-request containment the chaos suite exercises."""
+        if self._fault_plan is not None:
+            try:
+                self._retry(
+                    "request_apply",
+                    lambda: self._fault_check("request_apply", key=req.id),
+                )
+            except Exception as e:  # noqa: BLE001 — contain to this request
+                self._contain_rows("request_apply", [(row, req)], e)
+                return
         req.tokens.append(t)
         now = time.perf_counter()
         if req.first_token_at is None:

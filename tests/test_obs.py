@@ -175,6 +175,59 @@ def test_metrics_server_endpoints():
         ms.stop()
 
 
+def test_healthz_reflects_health_provider():
+    """/healthz answers from the attached health state machine: 200 only on
+    SERVING, 503 with the state name on DEGRADED/DRAINING, 503 when the
+    provider itself dies — so a load balancer can act on it."""
+    state = {"v": "SERVING"}
+    ms = MetricsServer(
+        port=0, registry=Registry(), health_provider=lambda: state["v"]
+    )
+    port = ms.start()
+    try:
+        assert _get(port, "/healthz") == b"ok\n"
+        for bad in ("DEGRADED", "DRAINING"):
+            state["v"] = bad
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(port, "/healthz")
+            assert ei.value.code == 503
+            assert ei.value.read() == f"{bad}\n".encode()
+        state["v"] = "SERVING"
+        assert _get(port, "/healthz") == b"ok\n"
+
+        def boom():
+            raise RuntimeError("provider died")
+
+        ms.set_health_provider(boom)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(port, "/healthz")
+        assert ei.value.code == 503 and b"unhealthy" in ei.value.read()
+        ms.set_health_provider(None)  # detached: back to bare liveness
+        assert _get(port, "/healthz") == b"ok\n"
+    finally:
+        ms.stop()
+
+
+def test_state_gauge_one_hot():
+    r = Registry()
+    sg = r.state_gauge("h_state", "health", states=("A", "B", "C"))
+    fam = r.get("h_state")
+    assert {v[0]: c.value for v, c in fam.series()} == {
+        "A": 0.0, "B": 0.0, "C": 0.0,
+    }
+    sg.set_state("B")
+    assert sg.state == "B"
+    assert {v[0]: c.value for v, c in fam.series()} == {
+        "A": 0.0, "B": 1.0, "C": 0.0,
+    }
+    sg.set_state("C")
+    assert {v[0]: c.value for v, c in fam.series()} == {
+        "A": 0.0, "B": 0.0, "C": 1.0,
+    }
+    with pytest.raises(ValueError):
+        sg.set_state("D")
+
+
 def _get(port, path):
     with urllib.request.urlopen(
         f"http://127.0.0.1:{port}{path}", timeout=10

@@ -144,6 +144,61 @@ def test_stage_memory_quantized_head_accounting():
     assert mixed[0] - all_int8[0] == want_delta > 0
 
 
+def test_calibrate_chain_grows_past_sync_jitter():
+    """ADVICE r5 regression: the old fixed-8× calibration measured a
+    NEGATIVE delta when sync jitter swamped the hop work (tunneled chip:
+    ~100 ms RTT vs µs of hops), clamping the per-hop estimate to 20 ns and
+    pegging n_long at the 1 M cap. The geometric calibration must keep
+    growing the chain until the delta provably exceeds the jitter floor,
+    then size n_long from SIGNAL — not land on the cap."""
+    from llm_sharding_tpu.profiler.profiler import _calibrate_chain
+
+    per_hop = 1e-6  # true cost the calibration should recover
+    # scripted timer: ~100 ms sync with jitter large enough that the FIRST
+    # 8× chain delta (256-32 hops = 224 µs of work) comes out negative
+    jitter = iter(
+        [0.0, 1e-3, 5e-4]            # run(short) × 3 → spread 1 ms
+        # n_mid=256 pairs (mid, short): the short draws the jitter spike,
+        # so every first-round delta is 224 µs − 2 ms < 0 — the exact
+        # negative-delta pathology
+        + [0.0, 2e-3, 0.0, 2e-3, 0.0, 2e-3]
+        + [0.0] * 100                 # later, larger chains measure clean
+    )
+
+    def make_run(n):
+        return lambda: 0.1 + next(jitter, 0.0) + n * per_hop
+
+    n_long, est, run_long = _calibrate_chain(make_run, 32)
+    assert n_long < 1_000_000, "calibration pegged at the cap (pathology)"
+    # the estimate comes from a chain whose delta beat the 10×-spread floor,
+    # so it is within a small factor of the true per-hop cost
+    assert per_hop / 3 < est < per_hop * 3
+    assert abs(n_long - 0.4 / est) <= max(0.05 * n_long, 2048)
+
+
+def test_calibrate_chain_caps_when_immeasurable():
+    """Genuinely immeasurable hops (delta never beats the floor) stop at
+    the cap with a non-degenerate positive estimate instead of looping."""
+    from llm_sharding_tpu.profiler.profiler import _calibrate_chain
+
+    calls = {"n": 0}
+
+    def make_run(n):
+        def run():
+            calls["n"] += 1
+            # pure alternating jitter, zero hop signal
+            return 0.1 + (1e-3 if calls["n"] % 2 else 0.0)
+
+        return run
+
+    n_long, est, run_long = _calibrate_chain(make_run, 32, cap=10_000)
+    assert n_long <= 10_000
+    assert est >= 20e-9
+    assert run_long is not None  # n_long == final n_mid: runner reused,
+    # sparing the duplicate compile of an identical-size chain
+    assert calls["n"] < 100  # bounded growth, no spin
+
+
 def test_measure_hop_latency_ring8():
     """The north-star secondary metric's machinery: chain-delta calibration
     over an 8-device ring yields a positive, stable per-hop figure (the
