@@ -492,6 +492,118 @@ def bench_fault_serve(on_tpu, engine):
     )
 
 
+def bench_paged_serve(on_tpu, engine):
+    """Paged KV serving (runtime/blocks.py + ops/paged_attention.py) on a
+    SKEWED-length workload at EQUAL HBM budget. Dense reserves ``capacity``
+    KV columns per row up front, so the budget admits exactly
+    ``dense_rows`` concurrent requests no matter how short most of them
+    are; paged carves the same slot count into blocks and each row holds
+    only the blocks covering its prompt + budget — on a skewed workload
+    (most requests short, a few long) that admits strictly MORE concurrent
+    rows, which is the serving headline (rows amortize the per-step weight
+    reads). Emits paged tok/s vs the dense run on the identical request
+    list, the measured max concurrency of both, and the internal
+    fragmentation (``serve_kv_waste_frac``) the operator tunes block size
+    against. Token agreement is EMITTED (greedy exactness between the two
+    layouts is proven by the f32 CPU tests, tests/test_paged.py; bf16 on
+    chip may round differently across layouts)."""
+    name = (
+        "serve_tok_s_paged_llama3.2-3b_1stage" if on_tpu
+        else "serve_tok_s_paged_tiny_cpu"
+    )
+    if on_tpu:
+        # equal budget: dense 16 rows x C=320 == paged 80x64-slot blocks.
+        # Workload: 5/6 short (32 new), 1/6 long (256 new) — short rows
+        # hold 1 block, long rows 5, so ~32 rows fit where dense holds 16
+        dense_rows, capacity, chunk_cycles, depth = 16, 320, 8, 2
+        paged_rows, block = 32, 64
+        prompt_len, short_new, long_new, long_every = 32, 32, 256, 6
+        n_requests = 64
+    else:
+        dense_rows, capacity, chunk_cycles, depth = 2, 64, 2, 1
+        paged_rows, block = 4, 16
+        prompt_len, short_new, long_new, long_every = 8, 8, 40, 4
+        n_requests = 8
+    # equal HBM budget PER STAGE: every stage's dense cache holds
+    # total_rows x capacity KV slots (total rows = pipeline slots x
+    # batch_per_slot — runtime/server M), and the paged arena replaces
+    # exactly that slot count with blocks. On the 1-stage TPU config this
+    # reduces to dense_rows x capacity (16x320 == 80 64-slot blocks)
+    from llm_sharding_tpu.parallel.mesh import PIPE_AXIS
+
+    n_slots = engine.mesh.shape[PIPE_AXIS]
+    budget_slots = n_slots * dense_rows * capacity
+    kv_blocks = budget_slots // block + 1  # +1: the reserved trash block
+    cfg = engine.cfg
+    rng = np.random.default_rng(13)
+    workload = [
+        (
+            rng.integers(0, cfg.vocab_size, prompt_len).astype(np.int32),
+            long_new if i % long_every == long_every - 1 else short_new,
+        )
+        for i in range(n_requests)
+    ]
+
+    def run(paged):
+        srv = engine.serve(
+            capacity=capacity,
+            batch_per_slot=paged_rows if paged else dense_rows,
+            chunk_cycles=chunk_cycles, pipeline_depth=depth,
+            **(dict(kv_block_size=block, kv_blocks=kv_blocks) if paged
+               else {}),
+        )
+        reqs = [srv.submit(p, max_new_tokens=n) for p, n in workload]
+        max_rows, waste = 0, []
+        t0 = time.perf_counter()
+        while any(not r.done for r in reqs):
+            srv.step()
+            max_rows = max(
+                max_rows,
+                sum(r is not None and not r.done for r in srv._rows),
+            )
+            if paged and srv._alloc.in_use:
+                live = sum(
+                    int(srv._mirror_len[i])
+                    for i, r in enumerate(srv._rows)
+                    if r is not None and not r.done
+                )
+                waste.append(
+                    max(0.0, 1.0 - live / (srv._alloc.in_use * block))
+                )
+        dt = time.perf_counter() - t0
+        toks = [list(r.tokens) for r in reqs]
+        tok_s = sum(len(t) for t in toks) / dt
+        del srv
+        gc.collect()
+        return tok_s, max_rows, toks, (
+            sum(waste) / len(waste) if waste else 0.0
+        )
+
+    run(False)  # compile dense admit + chunk at this shape
+    dense_tok_s, dense_max, dense_toks, _ = run(False)
+    run(True)  # compile the paged programs
+    paged_tok_s, paged_max, paged_toks, waste_frac = run(True)
+    if on_tpu and paged_max <= dense_max:
+        # the acceptance bar: same HBM, strictly more concurrent rows
+        raise RuntimeError(
+            f"paged admitted {paged_max} concurrent rows vs dense "
+            f"{dense_max} at equal budget ({budget_slots} KV slots)"
+        )
+    match = [
+        sum(a == b for a, b in zip(d, p)) / max(len(d), 1)
+        for d, p in zip(dense_toks, paged_toks)
+    ]
+    emit(
+        name, paged_tok_s, "tokens/sec", paged_tok_s / ANCHOR_TOK_S,
+        dense_tok_s=round(dense_tok_s, 2),
+        paged_rows_max=paged_max, dense_rows_max=dense_max,
+        kv_block_size=block, kv_blocks=kv_blocks,
+        hbm_budget_slots=budget_slots,
+        serve_kv_waste_frac=round(waste_frac, 4),
+        token_match_frac=round(sum(match) / len(match), 3),
+    )
+
+
 def bench_spec(on_tpu, cfg, params, jax, jnp):
     """Speculative decoding (n-gram self-drafting, runtime/spec.py) on a
     LOOKUP-FRIENDLY workload: the prompt is self-primed — the model's own
@@ -737,6 +849,10 @@ def main():
         "serve_fault_recovery_tok_s_llama3.2-3b_1stage" if on_tpu
         else "serve_fault_recovery_tok_s_tiny_cpu"
     )
+    npaged = (
+        "serve_tok_s_paged_llama3.2-3b_1stage" if on_tpu
+        else "serve_tok_s_paged_tiny_cpu"
+    )
 
     # section order = survival priority under a driver-side timeout:
     # 3B (anchor emitted immediately) → serve → 3B-int8 → pallas → 7B(+int8)
@@ -780,6 +896,18 @@ def main():
                 bench_prefix_cache(on_tpu, serve_engine)
             except Exception as e:  # noqa: BLE001
                 emit_error(nprefix, "x_speedup_vs_full_prefill", e)
+        # paged-KV serve (skewed-length, equal-HBM dense-vs-paged) reuses
+        # the live serve engine
+        if serve_engine is None:
+            emit_error(npaged, "tokens/sec",
+                       "not attempted: serve engine unavailable")
+        elif remaining() < 180:
+            emit_skip(npaged, "tokens/sec", 180)
+        else:
+            try:
+                bench_paged_serve(on_tpu, serve_engine)
+            except Exception as e:  # noqa: BLE001
+                emit_error(npaged, "tokens/sec", e)
         # fault-injection serve (robustness overhead) reuses the serve
         # engine before it is torn down
         if serve_engine is None:
@@ -852,6 +980,7 @@ def main():
             gc.collect()
     else:
         emit_error(nserve, "tokens/sec", "not attempted: 3B section failed")
+        emit_error(npaged, "tokens/sec", "not attempted: 3B section failed")
         emit_error(nprefix, "x_speedup_vs_full_prefill",
                    "not attempted: 3B section failed")
         emit_error(nspec, "tokens/sec", "not attempted: 3B section failed")

@@ -291,6 +291,14 @@ def cmd_serve(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if bool(args.kv_block_size) != bool(args.kv_blocks):
+        print(
+            "error: --kv-block-size and --kv-blocks go together "
+            f"(got --kv-block-size {args.kv_block_size or 0}, "
+            f"--kv-blocks {args.kv_blocks or 0})",
+            file=sys.stderr,
+        )
+        return 2
     if getattr(args, "data_parallel", 1) > 1:
         # data-parallel daemon: D replica servers over disjoint device
         # groups behind a router (runtime/replicated.py). :placement is a
@@ -330,6 +338,8 @@ def cmd_serve(args) -> int:
             default_deadline_s=args.default_deadline or None,
             snapshot_every_s=args.snapshot_every or None,
             snapshot_path=args.snapshot_dir,
+            kv_block_size=args.kv_block_size or None,
+            kv_blocks=args.kv_blocks or None,
         )
         eng = srv.engines[0]
         print(
@@ -386,6 +396,9 @@ def cmd_serve(args) -> int:
                     ("max_queue", args.max_queue or None, srv.max_queue),
                     ("default_deadline", args.default_deadline or None,
                      srv.default_deadline_s),
+                    ("kv_block_size", args.kv_block_size or None,
+                     srv.kv_block_size),
+                    ("kv_blocks", args.kv_blocks or None, srv.kv_blocks),
                 )
                 if got != used
             ]
@@ -418,6 +431,8 @@ def cmd_serve(args) -> int:
                 default_deadline_s=args.default_deadline or None,
                 snapshot_every_s=args.snapshot_every or None,
                 snapshot_path=args.snapshot_dir,
+                kv_block_size=args.kv_block_size or None,
+                kv_blocks=args.kv_blocks or None,
             )
         # srv.capacity, not args.capacity: after --restore the daemon runs
         # at the SNAPSHOT's serve_kwargs (ADVICE r5 — the banner used to
@@ -866,6 +881,22 @@ def build_parser() -> argparse.ArgumentParser:
         help="default per-request deadline in seconds from submission "
         "(0 = none): still queued past it -> shed at admit time; "
         "mid-decode past it -> cancelled at the next chunk boundary",
+    )
+    s.add_argument(
+        "--kv-block-size", type=int, default=0, dest="kv_block_size",
+        help="paged KV serving: tokens per arena block (power of two, e.g. "
+        "64). With --kv-blocks, replaces the per-row dense cache "
+        "reservation with a pooled block arena + per-request block tables "
+        "(PagedAttention): HBM scales with tokens actually in flight, "
+        "shared prefixes are stored once, greedy output stays "
+        "token-identical to dense (0 = dense mode, the default)",
+    )
+    s.add_argument(
+        "--kv-blocks", type=int, default=0, dest="kv_blocks",
+        help="paged KV serving: total arena blocks (>= 2; block 0 is the "
+        "reserved trash sink). KV HBM per stage is roughly kv-blocks x "
+        "kv-block-size x Nkv x Dh x 2 x dtype-bytes x layers-per-stage; "
+        "admission waits in queue when free blocks run out",
     )
     s.add_argument(
         "--snapshot-every", type=float, default=0.0, dest="snapshot_every",

@@ -74,6 +74,31 @@ def init_cache(
     )
 
 
+def block_pool_shape(
+    cfg: ModelConfig,
+    num_blocks: int,
+    block_size: int,
+    num_layers: int | None = None,
+) -> tuple:
+    """Per-stage shape of the POOLED paged-KV arena: ``[L, num_blocks,
+    block_size, Nkv, Dh]`` — the paged replacement for a dense cache's
+    ``[L, B, C, Nkv, Dh]``. Block 0 is reserved as the trash sink
+    (``runtime/blocks.TRASH_BLOCK``); rows own block subsets through the
+    per-row block tables in ``parallel/serve.ServeState``, so total KV HBM
+    scales with tokens actually in flight instead of rows × capacity."""
+    L = cfg.num_hidden_layers if num_layers is None else num_layers
+    if num_blocks < 2:
+        raise ValueError(
+            f"num_blocks must be >= 2 (block 0 is the reserved trash "
+            f"sink), got {num_blocks}"
+        )
+    if block_size < 1 or (block_size & (block_size - 1)):
+        raise ValueError(
+            f"block_size must be a power of two, got {block_size}"
+        )
+    return (L, num_blocks, block_size, cfg.num_key_value_heads, cfg.head_dim_)
+
+
 def clear(cache: KVCache) -> KVCache:
     """Reset without reallocating (≙ reference ``clear_KV_cache``,
     ``/root/reference/utils/node_worker.py:319-355``)."""

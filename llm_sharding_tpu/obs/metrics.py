@@ -417,6 +417,30 @@ class Registry:
 REGISTRY = Registry()
 
 
+# -- paged KV memory (runtime/blocks.py + runtime/server.py) ----------------
+# Defined here (not in the server module) so the three gauges exist — and
+# show 0 — on /statz and the :stats control line even before the first
+# paged server is constructed; the server's load-gauge sweep keeps them
+# current, summed over live paged servers like server_queue_depth.
+KV_BLOCKS_TOTAL = REGISTRY.gauge(
+    "server_kv_blocks_total",
+    "Allocatable KV arena blocks across live paged servers (the reserved "
+    "trash block excluded)",
+)
+KV_BLOCKS_IN_USE = REGISTRY.gauge(
+    "server_kv_blocks_in_use",
+    "KV arena blocks currently held by live requests or shared prefixes",
+)
+KV_WASTE_FRAC = REGISTRY.gauge(
+    "server_kv_waste_frac",
+    "1 - live tokens / allocated token slots over the in-use blocks: the "
+    "internal fragmentation of the paged KV pool (dense serving's "
+    "equivalent figure is 1 - live/capacity per row). Shared prefix "
+    "tokens count once per mapping row, so heavy sharing can drive this "
+    "to 0",
+)
+
+
 # -- compile/shape-key visibility -----------------------------------------
 
 _SHAPE_KEYS_SEEN: set = set()

@@ -1,1 +1,9 @@
-from . import attention, flash_attention, norms, ring_attention, rope, sampling  # noqa: F401
+from . import (  # noqa: F401
+    attention,
+    flash_attention,
+    norms,
+    paged_attention,
+    ring_attention,
+    rope,
+    sampling,
+)

@@ -16,7 +16,6 @@ row).
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 
@@ -67,58 +66,16 @@ def cached_attention(
     return out.reshape(B, S, Nh, D).astype(q.dtype)
 
 
-def bucketed_decode_attention(
-    q: jnp.ndarray,  # [B, 1, Nh, D]
-    k_cache: jnp.ndarray,  # [B, C, Nkv, D]
-    v_cache: jnp.ndarray,
-    q_positions: jnp.ndarray,  # [B, 1]
-    kv_positions: jnp.ndarray,  # [B, C]
-    length: jnp.ndarray,  # scalar int32: live entries occupy slots [0, length+S)
-    scale: float | None = None,
-    min_bucket: int = 256,
-) -> jnp.ndarray:
-    """Decode-shaped attention: attend over the smallest power-of-two cache
-    prefix that covers the live entries instead of the full capacity.
-
-    The cache writes sequentially from slot 0 (``models/cache.py``: slot
-    index == write order, ``length`` is the shared offset), so every live
-    entry lives in ``[0, length + S)`` — a static prefix slice per bucket is
-    exact, and position-sentinel masking inside the slice handles validity as
-    usual. ``lax.switch`` executes only the selected branch, so a step at
-    live length 100 reads 256 cache slots from HBM, not all C.
-
-    Measured caveat (v5e, 3B, C=4096): used per-layer inside the decode scan
-    this is SLOWER than full-capacity attention (62 vs 75 tok/s) — XLA
-    copies the full cache operands into the selected conditional branch. The
-    production decode path therefore buckets at the HOST level instead
-    (segmented ``while_loop`` in ``runtime/generate.py``); this op remains
-    for callers that can amortize the branch copy (e.g. one switch per
-    request, not per layer-step).
-    """
-    B, S, Nh, D = q.shape
-    C = k_cache.shape[1]
-    buckets = []
-    b = min_bucket
-    while b < C:
-        buckets.append(b)
-        b *= 2
-    buckets.append(C)
-    if len(buckets) == 1:
-        return cached_attention(
-            q, k_cache, v_cache, q_positions, kv_positions, scale
-        )
-
-    live = length + S
-    idx = sum((live > b).astype(jnp.int32) for b in buckets[:-1])
-
-    def branch(bk):
-        def f(ops):
-            q, k, v, qp, kvp = ops
-            return cached_attention(q, k[:, :bk], v[:, :bk], qp, kvp[:, :bk], scale)
-
-        return f
-
-    return jax.lax.switch(
-        idx, [branch(bk) for bk in buckets],
-        (q, k_cache, v_cache, q_positions, kv_positions),
-    )
+# ``bucketed_decode_attention`` (the decode-window ``lax.switch`` over
+# power-of-two cache prefixes) was RETIRED here: measured on v5e (3B,
+# C=4096) it was slower than full-capacity attention — 62 vs 75 tok/s —
+# because XLA copies the full cache operands into the selected conditional
+# branch (the README "Paged KV serving" section keeps the figure). Its
+# goal — decode HBM traffic proportional to the live prefix, not the
+# capacity — is what ``ops/paged_attention.py`` is built for: the
+# standalone op reads exactly the row's mapped blocks (XLA gather) or
+# streams them straight from the arena (Pallas kernel), with no branch
+# copy. NOTE the serve programs don't call it yet — they still gather the
+# full logical window at the shard_map boundary, so paged SERVING today
+# wins on concurrency (rows sized by actual tokens), not decode
+# bandwidth; wiring the kernel into the stage functions is future work.

@@ -1,0 +1,279 @@
+"""Ragged paged attention over a pooled KV arena (PagedAttention, Kwon et
+al., SOSP'23 — the vLLM allocation model, TPU-native).
+
+Dense serving reserves ``capacity`` KV columns per row and decode attention
+reads all of them every step (``ops/attention.cached_attention`` over
+``[B, C, ...]``). Paged serving stores KV in a shared arena of fixed-size
+blocks ``[num_blocks, block_size, Nkv, D]``; each row maps the blocks
+covering its ACTUAL tokens through a block table ``[B, T]`` (entry 0 — the
+reserved trash block — pads unmapped slots). This module provides the
+attention over that layout:
+
+- ``gather_block_kv`` / ``paged_attention_xla``: the exact XLA path — an
+  advanced-indexing gather assembles each row's logical window, then the
+  standard position-masked attention runs over it. This is what the tier-1
+  CPU mesh (and the serve programs in ``parallel/serve.py``, which gather
+  at the shard_map boundary) execute; numerics are identical to dense
+  attention over the same positions by construction.
+- ``paged_attention_tpu``: a Pallas kernel that never materializes the
+  gathered window in HBM. The block table rides as a SCALAR-PREFETCH
+  operand (``pltpu.PrefetchScalarGridSpec``), so each grid step's
+  ``BlockSpec`` index map picks the arena block to DMA directly from the
+  table — KV traffic per step is ``T × block_size`` slots (the row's
+  mapped window), not the dense capacity, and blocks stream through VMEM
+  with online-softmax accumulation exactly like ``ops/flash_attention``.
+- ``paged_attention``: backend dispatch (pallas on TPU for MXU-aligned
+  head_dim, XLA elsewhere). Same masking contract everywhere:
+  ``kv_pos <= q_pos``, sentinel = masked — so never-written block tails
+  drop out for free, and trash-mapped entries (block 0) additionally
+  gather/stream as ZEROS (both paths): the shared trash block accumulates
+  parked rows' garbage, and a non-finite garbage value would otherwise
+  turn the masked probability-0 positions into ``0 × Inf = NaN``.
+
+The retired ``bucketed_decode_attention`` (the decode-window ``lax.switch``
+whose branch copies made it SLOWER than full-capacity attention — see the
+measured note in README) is superseded by this op: as a STANDALONE op,
+block granularity gives the live-prefix-only HBM traffic the bucketed
+switch was after, without copying the cache into a conditional branch.
+The serve programs don't call it yet — they gather the full logical
+window at the shard_map boundary (exact, but full-window traffic), so the
+serving win today is concurrency, not decode bandwidth; wiring
+``paged_attention_tpu`` into the stage functions is future work.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import cached_attention
+from .. import _compat
+
+NEG_INF = -1e30  # python float: jnp constants can't be captured by kernels
+
+
+def gather_block_kv(
+    k_arena: jnp.ndarray,  # [NB, BS, Nkv, D] pooled key blocks
+    v_arena: jnp.ndarray,  # [NB, BS, Nkv, D]
+    block_table: jnp.ndarray,  # [B, T] int32 arena block ids per row
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Assemble each row's logical KV window ``[B, T*BS, Nkv, D]`` from the
+    arena. The gather is the XLA fallback's only extra cost over dense
+    attention; duplicate table entries (shared prefix blocks, trash
+    padding) are plain repeated reads. Trash-mapped entries (block 0)
+    gather as ZEROS: the shared trash block accumulates parked rows'
+    garbage writes, and although attention masks those positions to
+    probability exactly 0, a non-finite garbage value would still produce
+    ``0 × Inf = NaN`` in the PV product — zeroing closes the channel
+    without touching live numerics."""
+    B, T = block_table.shape
+    BS = k_arena.shape[1]
+    live = (block_table != 0)[:, :, None, None, None]
+    k = jnp.where(live, k_arena[block_table], jnp.zeros((), k_arena.dtype))
+    v = jnp.where(live, v_arena[block_table], jnp.zeros((), v_arena.dtype))
+    return (
+        k.reshape(B, T * BS, *k_arena.shape[2:]),
+        v.reshape(B, T * BS, *v_arena.shape[2:]),
+    )
+
+
+def paged_attention_xla(
+    q: jnp.ndarray,  # [B, S, Nh, D] (RoPE'd)
+    k_arena: jnp.ndarray,  # [NB, BS, Nkv, D]
+    v_arena: jnp.ndarray,
+    block_table: jnp.ndarray,  # [B, T]
+    q_positions: jnp.ndarray,  # [B, S]
+    kv_positions: jnp.ndarray,  # [B, T*BS] logical-column key positions
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Gather + position-masked attention: exact on every backend."""
+    k, v = gather_block_kv(k_arena, v_arena, block_table)
+    return cached_attention(q, k, v, q_positions, kv_positions, scale)
+
+
+def _paged_kernel(
+    tbl_ref,  # scalar-prefetch [B, T] (read by the index maps + trash gate)
+    q_ref,  # [1, 1, GS, D]
+    k_ref,  # [1, 1, BS, D] — the arena block the index map picked
+    v_ref,  # [1, 1, BS, D]
+    qpos_ref,  # [1, GS, 1] sublane-major
+    kvpos_ref,  # [1, 1, BS] lane-major (logical columns of block t)
+    out_ref,  # [1, 1, GS, D]
+    acc_ref,  # scratch [GS, D] f32
+    m_ref,  # scratch [GS, 128] f32
+    l_ref,  # scratch [GS, 128] f32
+    *,
+    scale,
+    t_blocks,
+):
+    t = pl.program_id(2)
+
+    @pl.when(t == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0, 0]  # [GS, D]
+    # trash blocks (table entry 0) stream as zeros: their garbage contents
+    # are position-masked to probability 0 below, but non-finite garbage
+    # would still NaN the masked positions (0 x Inf) through the score and
+    # PV products. where(), not multiply — Inf * 0 is itself NaN.
+    live = tbl_ref[pl.program_id(0), pl.program_id(2)] != 0
+    k = jnp.where(live, k_ref[0, 0], jnp.zeros_like(k_ref[0, 0]))  # [BS, D]
+    v = jnp.where(live, v_ref[0, 0], jnp.zeros_like(v_ref[0, 0]))
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [GS, BS] f32
+
+    # same layout contract as ops/flash_attention._flash_kernel: qpos rides
+    # sublane-major, kvpos lane-major, so the mask broadcast maps onto the
+    # score tile with no Mosaic relayout. Sentinel positions (trash-mapped
+    # slots, never-written block tails) mask out here; an all-masked block
+    # leaves a NEG_INF running max that the first real block's correction
+    # factor wipes (see the flash kernel's masking note).
+    mask = kvpos_ref[0] <= qpos_ref[0]  # [GS, BS]
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_prev = m_ref[:, :1]
+    l_prev = l_ref[:, :1]
+    m_blk = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_blk)
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [GS, D]
+    acc_ref[:] = acc_ref[:] * corr + pv
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(t == t_blocks - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        out_ref[0, 0] = (acc_ref[:] / jnp.maximum(l, 1e-30)).astype(
+            out_ref.dtype
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_attention_tpu(
+    q: jnp.ndarray,  # [B, S, Nh, D]
+    k_arena: jnp.ndarray,  # [NB, BS, Nkv, D]
+    v_arena: jnp.ndarray,
+    block_table: jnp.ndarray,  # [B, T] int32
+    q_positions: jnp.ndarray,  # [B, S]
+    kv_positions: jnp.ndarray,  # [B, T*BS]
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Pallas paged attention: grid ``(B, Nkv, T)``, the T axis sequential.
+    Each step DMAs ONE arena block, chosen by the scalar-prefetched block
+    table — the gathered window never exists in HBM. GQA-folded like the
+    flash kernel (each KV block streams once per KV head, not per query
+    head). Decode-shaped: GS = G·S query rows stay in one tile, so keep
+    ``G·S`` small (serving decode is S=1).
+
+    VMEM per step is one (BS, D) K block + V block + the (GS, BS) score
+    tile + (GS, D)+2·(GS, 128) scratch — tiny at serving block sizes (e.g.
+    BS=64, D=128: ~100 KB). Real-TPU use wants D a lane multiple (128) and
+    BS a sublane multiple for the cache dtype; ``paged_attention`` gates on
+    that and interpret-mode covers the rest."""
+    B, S, Nh, D = q.shape
+    NB, BS, Nkv = k_arena.shape[0], k_arena.shape[1], k_arena.shape[2]
+    T = block_table.shape[1]
+    G = Nh // Nkv
+    GS = G * S
+    if scale is None:
+        scale = D ** -0.5
+    if kv_positions.shape != (B, T * BS):
+        raise ValueError(
+            f"kv_positions must be [B, T*BS]={B, T * BS}, got "
+            f"{kv_positions.shape}"
+        )
+
+    # GQA fold (the reshape contract of cached_attention: head h = k*G + g)
+    qh = jnp.transpose(q, (0, 2, 1, 3)).reshape(B, Nkv, GS, D)
+    qp = jnp.tile(q_positions, (1, G))[..., None]  # [B, GS, 1]
+    kh = jnp.transpose(k_arena, (0, 2, 1, 3))  # [NB, Nkv, BS, D]
+    vh = jnp.transpose(v_arena, (0, 2, 1, 3))
+    kp = kv_positions[:, None, :]  # [B, 1, T*BS]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, Nkv, T),
+        in_specs=[
+            pl.BlockSpec((1, 1, GS, D), lambda b, k, t, tbl: (b, k, 0, 0)),
+            # the paged step: the arena block this grid cell streams is the
+            # table entry, read at index-map time from the prefetched scalars
+            pl.BlockSpec(
+                (1, 1, BS, D), lambda b, k, t, tbl: (tbl[b, t], k, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, BS, D), lambda b, k, t, tbl: (tbl[b, t], k, 0, 0)
+            ),
+            pl.BlockSpec((1, GS, 1), lambda b, k, t, tbl: (b, 0, 0)),
+            pl.BlockSpec((1, 1, BS), lambda b, k, t, tbl: (b, 0, t)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, GS, D), lambda b, k, t, tbl: (b, k, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((GS, D), jnp.float32),
+            pltpu.VMEM((GS, 128), jnp.float32),
+            pltpu.VMEM((GS, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, scale=scale, t_blocks=T),
+        out_shape=jax.ShapeDtypeStruct((B, Nkv, GS, D), q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=_compat.pallas_tpu_compiler_params()(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(block_table, qh, kh, vh, qp, kp)
+    out = out.reshape(B, Nkv, G, S, D)
+    return jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(B, S, Nh, D)
+
+
+def paged_attention(
+    q: jnp.ndarray,
+    k_arena: jnp.ndarray,
+    v_arena: jnp.ndarray,
+    block_table: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    kv_positions: jnp.ndarray,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Backend dispatch: the Pallas kernel on TPU for MXU-aligned shapes,
+    the exact XLA gather path otherwise (CPU meshes, ragged head dims,
+    sub-sublane block sizes). Identical numerics either way
+    (interpret-mode tested on CPU)."""
+    D = q.shape[-1]
+    BS = k_arena.shape[1]
+    # Mosaic tiles the (BS, D) block as (sublane, 128): D must be a lane
+    # multiple and BS a sublane multiple for the CACHE dtype (8 at 4
+    # bytes, 16 at 2, 32 at 1) — the tiny-block CI configs (BS=4) fall
+    # back to the exact gather path instead of a Mosaic layout error
+    sublane = 32 // max(jnp.dtype(k_arena.dtype).itemsize, 1)
+    use_pallas = (
+        jax.default_backend() == "tpu"
+        and D % 128 == 0
+        and BS % sublane == 0
+    )
+    if use_pallas:
+        return paged_attention_tpu(
+            q, k_arena, v_arena, block_table, q_positions, kv_positions,
+            scale,
+        )
+    return paged_attention_xla(
+        q, k_arena, v_arena, block_table, q_positions, kv_positions, scale
+    )

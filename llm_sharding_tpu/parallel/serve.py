@@ -72,9 +72,14 @@ class ServeState(NamedTuple):
     leading stage dim); the rest are replicated bookkeeping.
     """
 
-    k: jax.Array          # [dev] [S, Lp, M, C, Nkv, Dh]
-    v: jax.Array          # [dev] [S, Lp, M, C, Nkv, Dh]
-    kpos: jax.Array       # [dev] [S, M, C] key positions / sentinel
+    k: jax.Array          # [dev] dense: [S, Lp, M, C, Nkv, Dh];
+    #   paged: the pooled arena [S, Lp, NB, BS, Nkv, Dh] — rows own block
+    #   subsets via ``block_tables`` (block 0 = the reserved trash sink)
+    v: jax.Array          # [dev] same layout as k
+    kpos: jax.Array       # [dev] [S, M, W] key positions / sentinel, indexed
+    #   by LOGICAL column (dense: W == C == the cache column; paged: column
+    #   c lives in arena block table[row, c // BS] at slot c % BS) — always
+    #   per-row private, so position masking is mode-independent
     h: jax.Array          # [dev] [S, Bs, 1, H] in-flight ring block
     h_valid: jax.Array    # [dev] [S] bool — the held block is real data
     pos_slots: jax.Array  # [dev] [S, M] this device's view of row positions
@@ -89,6 +94,10 @@ class ServeState(NamedTuple):
     temp: jax.Array       # [M] f32 sampling temperature (<= 0 → greedy)
     topk: jax.Array       # [M] int32 per-row top-k (0 → off)
     topp: jax.Array       # [M] f32 per-row top-p (1.0 → off)
+    block_tables: jax.Array  # [M, T] int32 per-row arena block ids (paged
+    #   mode; replicated — the host owns it and pushes updates between
+    #   dispatches). Dense mode carries a [M, 1] placeholder so the pytree
+    #   structure (state_specs parity, snapshots) is mode-independent.
     m: jax.Array          # scalar int32 microstep counter
 
 
@@ -119,8 +128,51 @@ def state_specs(state: ServeState, tp: int = 1) -> ServeState:
         k=kv, v=kv, kpos=dev, h=dev, h_valid=dev, pos_slots=dev,
         write_off=dev, out=rep, lengths=rep, done=rep, budget=rep,
         inject=rep, inject_pending=rep, rng=rep, temp=rep, topk=rep,
-        topp=rep, m=rep,
+        topp=rep, block_tables=rep, m=rep,
     )
+
+
+# ---- paged-KV window assembly ---------------------------------------------
+# Inside the shard_map bodies a slot's rows are normally a dynamic SLICE of
+# the per-row cache; in paged mode they are a GATHER through the rows' block
+# tables instead — the logical [Lp, Bs, W, Nkv, Dh] window the stage fns see
+# is identical either way, which is why paged greedy serving is
+# token-identical to dense by construction (attention depends only on the
+# gathered values and the per-row logical kpos). The scatter back may hit
+# duplicate arena blocks across rows — shared prefix blocks (every duplicate
+# writes the identical broadcast values) and the trash block (a garbage
+# sink) — so last-wins scatter order is immaterial.
+
+
+def _gather_pages(arena, tbl, block_size):
+    """``arena [Lp, NB, BS, ...] , tbl [Bs, T] -> [Lp, Bs, T*BS, ...]``.
+
+    Trash-mapped entries (block 0) gather as ZEROS, not the trash block's
+    contents: parked rows keep scattering garbage K/V there every
+    microstep, and while attention masks those positions to probability
+    exactly 0, bf16 garbage can feed back to ±Inf over a long run and
+    0 × Inf = NaN would then contaminate every live row through the one
+    SHARED block — a channel dense mode (private columns) doesn't have.
+    Zeroing is token-identical: the masked positions contribute 0 either
+    way, and in-program writes (admit prompt KV, spec-verify scratch) land
+    AFTER the gather, so fresh values are never affected."""
+    g = arena[:, tbl]  # [Lp, Bs, T, BS, ...]
+    Lp, Bs, T = g.shape[0], g.shape[1], g.shape[2]
+    live = (tbl != 0).reshape(1, Bs, T, 1, *([1] * (g.ndim - 4)))
+    g = jnp.where(live, g, jnp.zeros((), g.dtype))
+    return g.reshape(Lp, Bs, T * block_size, *g.shape[4:])
+
+
+def _scatter_pages(arena, tbl, window, block_size):
+    """Write a logical window back through the tables (inverse gather)."""
+    Lp, Bs, W = window.shape[0], window.shape[1], window.shape[2]
+    vals = window.reshape(Lp, Bs, W // block_size, block_size,
+                          *window.shape[3:])
+    return arena.at[:, tbl].set(vals)
+
+
+def _slot_tables(st, row0, Bs):
+    return jax.lax.dynamic_slice_in_dim(st.block_tables, row0, Bs, axis=0)
 
 
 def make_state(
@@ -133,13 +185,32 @@ def make_state(
     cache_dtype=jnp.bfloat16,
     act_dtype=jnp.bfloat16,
     tp: int = 1,
+    kv_blocks: int = 0,
+    kv_block_size: int = 0,
 ) -> ServeState:
-    """Host-constructed empty state (all slots free / done)."""
+    """Host-constructed empty state (all slots free / done).
+
+    With ``kv_blocks``/``kv_block_size`` set, the KV leaves become the
+    POOLED paged arena ``[S, Lp, kv_blocks, kv_block_size, Nkv, Dh]``
+    (``models/cache.block_pool_shape``) instead of per-row ``[.., M, C,
+    ..]`` reservations, and every row's logical window is ``W = ceil(C /
+    BS) * BS`` columns mapped through ``block_tables`` (all entries start
+    at the trash block 0). HBM then scales with the arena size the operator
+    budgets, not rows × capacity — the whole point of paged serving."""
     S = mesh.shape[PIPE_AXIS]
     Bs = batch_per_slot
     M = S * Bs
     Lp = layers_per_stage
-    C = capacity
+    paged = kv_block_size > 0
+    if paged:
+        # logical window: capacity rounded up to whole blocks. out/kpos are
+        # W wide so every column index the programs compute (write offsets,
+        # spec scratch at the top of the window) has a table-mapped home.
+        T = -(-capacity // kv_block_size)
+        C = T * kv_block_size
+    else:
+        T = 1  # dense placeholder table (leaf exists for pytree parity)
+        C = capacity
     H = cfg.hidden_size
     dev = NamedSharding(mesh, P(PIPE_AXIS))
     rep = NamedSharding(mesh, P())
@@ -171,7 +242,12 @@ def make_state(
 
         return put_global(np.zeros(shape, dtype), sh)
 
-    kv_shape = (S, Lp, M, C, cfg.num_key_value_heads, cfg.head_dim_)
+    if paged:
+        from ..models.cache import block_pool_shape
+
+        kv_shape = (S, *block_pool_shape(cfg, kv_blocks, kv_block_size, Lp))
+    else:
+        kv_shape = (S, Lp, M, C, cfg.num_key_value_heads, cfg.head_dim_)
     state = ServeState(
         k=zeros(kv_shape, cache_dtype, dev_kv),
         v=zeros(kv_shape, cache_dtype, dev_kv),
@@ -190,6 +266,7 @@ def make_state(
         temp=put(np.zeros((M,), np.float32), rep),
         topk=put(np.zeros((M,), np.int32), rep),
         topp=put(np.ones((M,), np.float32), rep),
+        block_tables=put(np.zeros((M, T), np.int32), rep),
         m=put(np.zeros((), np.int32), rep),
     )
     return state
@@ -295,6 +372,7 @@ def cancel_rows_batched(state: ServeState, rows, n_rows: int) -> ServeState:
     jax.jit,
     static_argnames=(
         "cfg", "mesh", "num_stages", "cache_dtype", "filtering", "tp",
+        "block_size",
     ),
     donate_argnums=(5,),  # the previous ServeState buffers are dead on
     # return (the server reassigns self.state) — donation halves the
@@ -323,9 +401,19 @@ def serve_admit(
     prefix_kv: Any = None,  # (k, v, pos) from prefix_prefill — prefix caching
     prefix_len: Any = None,  # scalar int32 real prefix length
     tp: int = 1,  # static: tensor-parallel degree (megatron-sharded heads)
+    block_size: int = 0,  # static: paged-KV block size (0 = dense state)
 ):
     """Prefill ``slot`` with up to Bs new requests while the rest of the
     pipeline state is parked. Returns the updated state.
+
+    Paged mode (``block_size > 0``): the fresh slot window is built exactly
+    as in dense mode (the window width IS ``state.out.shape[1]``), then
+    scattered through the slot rows' block tables instead of into per-row
+    cache columns. The host mapped the tables BEFORE this dispatch, so the
+    scatter fully initializes every block the rows own — including shared
+    prefix blocks, which receive the identical broadcast prefix values on
+    every admission that maps them (storage is shared; the broadcast is
+    the same per-admission compute dense mode pays).
 
     Returns ``(state, tok0)``: the first generated token per row, sampled at
     admission — the host appends it to the request and mirrors lengths/done
@@ -424,8 +512,17 @@ def serve_admit(
         # a prefix handle) drives every length-indexed bookkeeping field
         total = pfx + prompt_len
         off0 = 0 if prefix_kv is None else int(prefix_kv[0].shape[3])
-        k_new = jax.lax.dynamic_update_slice_in_dim(st.k, cache.k, row0, axis=1)
-        v_new = jax.lax.dynamic_update_slice_in_dim(st.v, cache.v, row0, axis=1)
+        if block_size:
+            tbl = _slot_tables(st, row0, Bs)
+            k_new = _scatter_pages(st.k, tbl, cache.k, block_size)
+            v_new = _scatter_pages(st.v, tbl, cache.v, block_size)
+        else:
+            k_new = jax.lax.dynamic_update_slice_in_dim(
+                st.k, cache.k, row0, axis=1
+            )
+            v_new = jax.lax.dynamic_update_slice_in_dim(
+                st.v, cache.v, row0, axis=1
+            )
         kpos_new = jax.lax.dynamic_update_slice_in_dim(
             st.kpos, cache.pos, row0, axis=0
         )
@@ -523,7 +620,7 @@ def serve_admit(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "mesh", "num_stages", "tp"),
+    static_argnames=("cfg", "mesh", "num_stages", "tp", "block_size"),
     donate_argnums=(5,),  # see serve_admit
 )
 def serve_prefill_chunk(
@@ -542,6 +639,7 @@ def serve_prefill_chunk(
     reset: jnp.ndarray,      # scalar bool — first chunk zeroes the slot rows
     num_stages: int,
     tp: int = 1,
+    block_size: int = 0,  # static: paged-KV block size (0 = dense state)
 ):
     """One bounded chunk of an admission prefill (r2 weak #4 / next-#4).
 
@@ -569,8 +667,13 @@ def serve_prefill_chunk(
             state_specs(state, tp), state,
         )
         row0 = slot * Bs
-        k_rows = jax.lax.dynamic_slice_in_dim(st.k, row0, Bs, axis=1)
-        v_rows = jax.lax.dynamic_slice_in_dim(st.v, row0, Bs, axis=1)
+        if block_size:
+            tbl = _slot_tables(st, row0, Bs)
+            k_rows = _gather_pages(st.k, tbl, block_size)
+            v_rows = _gather_pages(st.v, tbl, block_size)
+        else:
+            k_rows = jax.lax.dynamic_slice_in_dim(st.k, row0, Bs, axis=1)
+            v_rows = jax.lax.dynamic_slice_in_dim(st.v, row0, Bs, axis=1)
         p_rows = jax.lax.dynamic_slice_in_dim(st.kpos, row0, Bs, axis=0)
         zero = jnp.zeros_like(k_rows)
         sent = jnp.full_like(p_rows, POS_SENTINEL)
@@ -586,8 +689,16 @@ def serve_prefill_chunk(
             positions,
         )
 
-        k_new = jax.lax.dynamic_update_slice_in_dim(st.k, cache.k, row0, axis=1)
-        v_new = jax.lax.dynamic_update_slice_in_dim(st.v, cache.v, row0, axis=1)
+        if block_size:
+            k_new = _scatter_pages(st.k, tbl, cache.k, block_size)
+            v_new = _scatter_pages(st.v, tbl, cache.v, block_size)
+        else:
+            k_new = jax.lax.dynamic_update_slice_in_dim(
+                st.k, cache.k, row0, axis=1
+            )
+            v_new = jax.lax.dynamic_update_slice_in_dim(
+                st.v, cache.v, row0, axis=1
+            )
         kpos_new = jax.lax.dynamic_update_slice_in_dim(
             st.kpos, cache.pos, row0, axis=0
         )
@@ -732,6 +843,7 @@ def serve_admit_finish(
     jax.jit,
     static_argnames=(
         "cfg", "mesh", "num_stages", "n_micro", "sampling", "filtering", "tp",
+        "block_size",
     ),
     donate_argnums=(5,),  # see serve_admit
 )
@@ -747,6 +859,7 @@ def serve_chunk(
     sampling: bool = False,
     filtering: bool = True,
     tp: int = 1,
+    block_size: int = 0,  # static: paged-KV block size (0 = dense state)
 ):
     """Run ``n_micro`` interleaved microsteps on the live state. Returns
     ``(state, log)`` where ``log`` is ``[n_micro, Bs]`` int32 — the token
@@ -803,24 +916,42 @@ def serve_chunk(
             slot_active = ~jnp.all(done_served)
             advance = valid_now & slot_active
 
-            cache_r = KVCache(
-                k=jax.lax.dynamic_slice_in_dim(s.k, row0, Bs, axis=1),
-                v=jax.lax.dynamic_slice_in_dim(s.v, row0, Bs, axis=1),
-                pos=jax.lax.dynamic_slice_in_dim(s.kpos, row0, Bs, axis=0),
-                length=off_r,
-            )
+            if block_size:
+                tbl_r = _slot_tables(s, row0, Bs)
+                cache_r = KVCache(
+                    k=_gather_pages(s.k, tbl_r, block_size),
+                    v=_gather_pages(s.v, tbl_r, block_size),
+                    pos=jax.lax.dynamic_slice_in_dim(s.kpos, row0, Bs, axis=0),
+                    length=off_r,
+                )
+            else:
+                cache_r = KVCache(
+                    k=jax.lax.dynamic_slice_in_dim(s.k, row0, Bs, axis=1),
+                    v=jax.lax.dynamic_slice_in_dim(s.v, row0, Bs, axis=1),
+                    pos=jax.lax.dynamic_slice_in_dim(s.kpos, row0, Bs, axis=0),
+                    length=off_r,
+                )
             h_new, cache_r_new = fns.stage(
                 cfg, layers, h_in, cache_r, pos_rows[:, None], lmask
             )
             # Unconditional commit: a garbage write lands at an offset the
             # next real serve overwrites (offsets only advance on `advance`).
+            # Paged mode keeps this safe two ways: a LIVE row's write offset
+            # is always inside its own mapped blocks (the host covers the
+            # full prompt+budget at admission), and a FREED row's table was
+            # remapped to the trash block before its blocks could be
+            # reallocated — garbage from a done slot lands in the sink.
             def upd(big, small, axis):
                 return jax.lax.dynamic_update_slice_in_dim(
                     big, small, row0, axis=axis
                 )
 
-            k_st = upd(s.k, cache_r_new.k, 1)
-            v_st = upd(s.v, cache_r_new.v, 1)
+            if block_size:
+                k_st = _scatter_pages(s.k, tbl_r, cache_r_new.k, block_size)
+                v_st = _scatter_pages(s.v, tbl_r, cache_r_new.v, block_size)
+            else:
+                k_st = upd(s.k, cache_r_new.k, 1)
+                v_st = upd(s.v, cache_r_new.v, 1)
             kpos_st = upd(s.kpos, cache_r_new.pos, 0)
             write_off = jnp.where(
                 advance, s.write_off.at[r].add(1), s.write_off
@@ -947,6 +1078,7 @@ def serve_chunk(
     jax.jit,
     static_argnames=(
         "cfg", "mesh", "num_stages", "K", "sampling", "filtering", "tp",
+        "block_size",
     ),
     donate_argnums=(5,),  # see serve_admit
 )
@@ -970,6 +1102,7 @@ def serve_verify(
     sampling: bool = False,
     filtering: bool = True,
     tp: int = 1,
+    block_size: int = 0,  # static: paged-KV block size (0 = dense state)
 ):
     """Speculative verify for one slot: ONE parked-pipeline ring traversal
     over the K+1 draft positions per row — a tiny prefill (the ``serve_admit``
@@ -1037,12 +1170,28 @@ def serve_verify(
             out_rows, jnp.clip(len_rows - 1, 0, C_total - 1)[:, None], axis=1
         )[:, 0]
 
-        cache = KVCache(
-            k=jax.lax.dynamic_slice_in_dim(st.k, row0, Bs, axis=1),
-            v=jax.lax.dynamic_slice_in_dim(st.v, row0, Bs, axis=1),
-            pos=jax.lax.dynamic_slice_in_dim(st.kpos, row0, Bs, axis=0),
-            length=jnp.asarray(scratch, jnp.int32),
-        )
+        # Paged note: the scratch columns at the top of the window live in
+        # TRASH-mapped table entries for every row — legitimate because
+        # scratch never persists across programs (this traversal writes the
+        # K+1 entries locally, the compaction below reads them from the
+        # SAME local window, and the scratch kpos is rewound to the
+        # sentinel before the scatter), so no dedicated scratch blocks are
+        # ever allocated.
+        if block_size:
+            tbl = _slot_tables(st, row0, Bs)
+            cache = KVCache(
+                k=_gather_pages(st.k, tbl, block_size),
+                v=_gather_pages(st.v, tbl, block_size),
+                pos=jax.lax.dynamic_slice_in_dim(st.kpos, row0, Bs, axis=0),
+                length=jnp.asarray(scratch, jnp.int32),
+            )
+        else:
+            cache = KVCache(
+                k=jax.lax.dynamic_slice_in_dim(st.k, row0, Bs, axis=1),
+                v=jax.lax.dynamic_slice_in_dim(st.v, row0, Bs, axis=1),
+                pos=jax.lax.dynamic_slice_in_dim(st.kpos, row0, Bs, axis=0),
+                length=jnp.asarray(scratch, jnp.int32),
+            )
         toks_in = jnp.concatenate([tok_pend[:, None], draft], axis=1)
         positions = jnp.where(
             done_rows[:, None], POS_SENTINEL,
@@ -1165,9 +1314,19 @@ def serve_verify(
             rng_new = jnp.where((c > 0)[:, None], new_keys, rng_rows)
         inject_pending = st.inject_pending.at[rows].set(False)
 
+        if block_size:
+            k_full = _scatter_pages(st.k, tbl, k_slot, block_size)
+            v_full = _scatter_pages(st.v, tbl, v_slot, block_size)
+        else:
+            k_full = jax.lax.dynamic_update_slice_in_dim(
+                st.k, k_slot, row0, axis=1
+            )
+            v_full = jax.lax.dynamic_update_slice_in_dim(
+                st.v, v_slot, row0, axis=1
+            )
         new = st._replace(
-            k=jax.lax.dynamic_update_slice_in_dim(st.k, k_slot, row0, axis=1),
-            v=jax.lax.dynamic_update_slice_in_dim(st.v, v_slot, row0, axis=1),
+            k=k_full,
+            v=v_full,
             kpos=jax.lax.dynamic_update_slice_in_dim(
                 st.kpos, pos_slot, row0, axis=0
             ),

@@ -1,0 +1,167 @@
+"""Host-side KV block allocator for paged serving.
+
+The dense serve state reserves the full cache capacity ``C`` per row up
+front (``parallel/serve.make_state``: ``k/v [S, Lp, M, C, Nkv, Dh]``) — a
+short request holds exactly as much HBM as the longest one the server can
+admit. Paged mode (PagedAttention, Kwon et al., SOSP'23) replaces the
+per-row reservation with a POOLED arena ``[S, Lp, num_blocks, block_size,
+Nkv, Dh]``; each row owns only the blocks covering its actual prompt +
+budget, mapped through a per-row block table the device programs gather
+through (``parallel/serve.py``). This module is the host half: a free list
+with per-block reference counts.
+
+Design points:
+
+- **Block 0 is the trash sink**, never allocated. Every unmapped table
+  entry points at it, so the interleaved schedule's unconditional garbage
+  writes (``serve_chunk``'s "a garbage write lands at an offset the next
+  real serve overwrites") land in a block nobody attends — the paged
+  analogue of a dense row's private padding columns. Freeing a row is
+  therefore two steps in strict order: remap its table to the trash block
+  on device, THEN return the blocks to the free list (dispatch order makes
+  this safe: any in-flight program predates the remap, any later program
+  sees trash — a recycled block is always fully re-initialized by its new
+  owner's admission before anything reads it).
+- **Refcounts enable block-level prefix sharing**: ``prefill_prefix``
+  allocates the prefix's blocks once; every admission ``share()``s them
+  into the row's table read-only and ``free()`` only returns a block to
+  the pool when its last reference drops.
+- **Exhaustion is a typed condition**, not a crash: ``alloc`` raises
+  ``BlockExhausted``; the server checks ``num_free`` first and leaves
+  requests queued (admission gated on free blocks — queue wait, FIFO
+  order preserved).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+TRASH_BLOCK = 0  # reserved garbage sink; table entries default here
+
+
+class BlockExhausted(RuntimeError):
+    """``alloc`` could not satisfy the request: every non-reserved block is
+    held. Callers shed or queue the admission instead of corrupting rows."""
+
+
+class BlockAllocator:
+    """Free list + per-block refcounts over ``num_blocks`` KV blocks of
+    ``block_size`` token slots each. Block 0 (``TRASH_BLOCK``) is reserved.
+    NOT thread-safe on its own — the owning server serializes every call
+    under its mutex."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block {TRASH_BLOCK} is the "
+                f"reserved trash sink), got {num_blocks}"
+            )
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # LIFO free list: a just-freed block is reused first, so a steady
+        # admit/finish churn touches a small hot set of arena blocks
+        self._free: list[int] = list(range(num_blocks - 1, 0, -1))
+        self._ref = np.zeros(num_blocks, np.int32)
+        self._ref[TRASH_BLOCK] = 1  # pinned forever
+
+    # ------------------------------------------------------------------ API
+
+    @property
+    def capacity_blocks(self) -> int:
+        """Allocatable blocks (the trash block never counts)."""
+        return self.num_blocks - 1
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.capacity_blocks - len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        """Take ``n`` blocks (refcount 1 each). Raises ``BlockExhausted``
+        without partial allocation when fewer than ``n`` are free."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            raise BlockExhausted(
+                f"need {n} KV blocks, {len(self._free)} free "
+                f"(of {self.capacity_blocks})"
+            )
+        taken = [self._free.pop() for _ in range(n)]
+        self._ref[taken] = 1
+        return taken
+
+    def share(self, blocks) -> None:
+        """Add a reference to each of ``blocks`` (prefix sharing: a row maps
+        an already-allocated block read-only into its table)."""
+        for b in blocks:
+            if self._ref[b] < 1 or b == TRASH_BLOCK:
+                raise ValueError(f"share of unallocated/reserved block {b}")
+            self._ref[b] += 1
+
+    def free(self, blocks) -> None:
+        """Drop one reference per block; a block returns to the free list
+        when its last reference drops."""
+        for b in blocks:
+            if b == TRASH_BLOCK:
+                raise ValueError("free of the reserved trash block")
+            if self._ref[b] < 1:
+                raise ValueError(f"double free of block {b}")
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                self._free.append(int(b))
+
+    def restore(self, private_rows, shared_rows) -> None:
+        """Rebuild allocation state from a snapshot's per-row ownership
+        lists (``runtime/server.py`` snapshot format 2): private blocks get
+        refcount 1, shared blocks one reference per row mapping them. Must
+        be called on a freshly constructed allocator."""
+        if self.in_use:
+            raise ValueError("restore on a non-empty allocator")
+        free = set(self._free)
+        for blocks in private_rows:
+            for b in blocks:
+                if b not in free:
+                    raise ValueError(
+                        f"snapshot block {b} double-owned or reserved"
+                    )
+                free.discard(b)
+                self._ref[b] = 1
+        for blocks in shared_rows:
+            for b in blocks:
+                if b in free:
+                    free.discard(b)
+                    self._ref[b] = 1
+                elif self._ref[b] >= 1:
+                    self._ref[b] += 1
+                else:
+                    raise ValueError(f"snapshot shared block {b} reserved")
+        # keep LIFO order deterministic after restore
+        self._free = sorted(free, reverse=True)
+
+    def check(self) -> None:
+        """Allocator invariant (the chaos suites call this after every
+        lifecycle path): free list and refcounted blocks exactly partition
+        the non-reserved pool, with no double entries."""
+        free = self._free
+        if len(set(free)) != len(free):
+            raise AssertionError(f"free list has duplicates: {free}")
+        for b in free:
+            if b == TRASH_BLOCK or not (0 < b < self.num_blocks):
+                raise AssertionError(f"bad free-list entry {b}")
+            if self._ref[b] != 0:
+                raise AssertionError(f"free block {b} has refcount {self._ref[b]}")
+        held = [
+            b for b in range(1, self.num_blocks) if self._ref[b] > 0
+        ]
+        if len(held) + len(free) != self.capacity_blocks:
+            raise AssertionError(
+                f"{len(held)} held + {len(free)} free != "
+                f"{self.capacity_blocks} blocks"
+            )
+        if self._ref[TRASH_BLOCK] != 1:
+            raise AssertionError("trash block refcount must stay pinned at 1")

@@ -506,6 +506,8 @@ class PipelineEngine:
         retryable_exceptions: tuple = (),
         snapshot_every_s: Optional[float] = None,
         snapshot_path: Optional[str] = None,
+        kv_block_size: Optional[int] = None,
+        kv_blocks: Optional[int] = None,
     ):
         """Build a continuous-batching server over this engine's sharded
         arrays (≙ the reference's persistent ``run_worker_loop`` daemon,
@@ -553,6 +555,8 @@ class PipelineEngine:
             retryable_exceptions=retryable_exceptions,
             snapshot_every_s=snapshot_every_s,
             snapshot_path=snapshot_path,
+            kv_block_size=kv_block_size,
+            kv_blocks=kv_blocks,
         )
 
     def _shared_server(self, prompt_len: int, max_new: int):

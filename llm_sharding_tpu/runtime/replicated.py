@@ -150,6 +150,19 @@ class ReplicatedServer:
             {s: s.prefill_prefix(prefix_ids) for s in self.servers}
         )
 
+    def release_prefix(self, handle: ReplicatedPrefixHandle) -> None:
+        """Release the per-replica handles (paged replicas return the
+        prefix's pinned blocks to their pools once the last mapping row
+        finishes; dense replicas no-op). Without this the per-replica
+        never-fits ceiling shrinks for the daemon's lifetime."""
+        if not isinstance(handle, ReplicatedPrefixHandle):
+            raise ValueError(
+                "release_prefix takes the ReplicatedPrefixHandle returned "
+                "by ReplicatedServer.prefill_prefix"
+            )
+        for s, h in handle.per_server.items():
+            s.release_prefix(h)
+
     def submit(self, prompt_ids, max_new_tokens: int = 128, **kw) -> Request:
         s = self._pick()
         pfx = kw.get("prefix")

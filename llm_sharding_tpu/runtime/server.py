@@ -73,7 +73,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..obs.metrics import DEFAULT_RATE_BUCKETS, REGISTRY, record_shape_key
+from ..obs.metrics import (
+    DEFAULT_RATE_BUCKETS, KV_BLOCKS_IN_USE, KV_BLOCKS_TOTAL, KV_WASTE_FRAC,
+    REGISTRY, record_shape_key,
+)
 from ..obs.trace import TraceWriter
 from ..parallel import serve as serve_ops
 from ..parallel.mesh import PIPE_AXIS
@@ -164,13 +167,36 @@ _LIVE_SERVERS: "weakref.WeakSet" = weakref.WeakSet()
 def _update_load_gauges() -> None:
     """Recompute the process-wide load gauges from every live server. Reads
     other servers' queue/rows without their mutex — len() and the row scan
-    are safe against torn reads, and a gauge one step stale is fine."""
+    are safe against torn reads, and a gauge one step stale is fine.
+
+    Also refreshes the paged-KV gauges (``server_kv_blocks_*``,
+    ``server_kv_waste_frac`` — ``obs/metrics.py``), summed over live PAGED
+    servers: waste is 1 − live tokens / allocated token slots, the
+    fragmentation the operator tunes ``kv_block_size`` against."""
     queued = active = 0
+    kv_total = kv_used = kv_slots = kv_live = 0
     for s in list(_LIVE_SERVERS):
         queued += len(s._queue)
         active += sum(r is not None and not r.done for r in s._rows)
+        if getattr(s, "paged", False):
+            kv_total += s._alloc.capacity_blocks
+            kv_used += s._alloc.in_use
+            kv_slots += s._alloc.in_use * s.kv_block_size
+            kv_live += sum(
+                int(s._mirror_len[i])
+                for i, r in enumerate(s._rows)
+                if r is not None and not r.done
+            )
     _M_QUEUE_DEPTH.set(queued)
     _M_ACTIVE.set(active)
+    KV_BLOCKS_TOTAL.set(kv_total)
+    KV_BLOCKS_IN_USE.set(kv_used)
+    # shared prefix tokens count once per mapping row (mirror lengths are
+    # prefix-inclusive) while their blocks are stored once — heavy sharing
+    # can push live past slots, which simply reads as zero waste
+    KV_WASTE_FRAC.set(
+        0.0 if kv_slots == 0 else max(0.0, 1.0 - kv_live / kv_slots)
+    )
 
 
 _M_FETCH_FAIL = REGISTRY.counter(
@@ -429,6 +455,13 @@ def save_snapshot(snap: dict, path: str) -> None:
         put(f"state.{k}", v)
     put("mirror_len", snap["mirror_len"])
     put("mirror_budget", snap["mirror_budget"])
+    paged_meta = None
+    if snap.get("paged") is not None:
+        put("paged.tables", snap["paged"]["tables"])
+        paged_meta = {
+            "row_blocks": snap["paged"]["row_blocks"],
+            "row_shared": snap["paged"]["row_shared"],
+        }
 
     def enc_reqs(kind: str, reqs) -> list:
         out = []
@@ -455,6 +488,7 @@ def save_snapshot(snap: dict, path: str) -> None:
         "rows": enc_reqs("rows", snap["rows"]),
         "queue": enc_reqs("queue", snap["queue"]),
         "dtype_tags": dtags,
+        "paged": paged_meta,
     }
     np.savez(os.path.join(tmp, "state.npz"), **arrays)
     with open(os.path.join(tmp, "state.npz"), "rb") as f:
@@ -557,6 +591,13 @@ def load_snapshot(path: str) -> dict:
         k[len("state."):]: v for k, v in arrays.items()
         if k.startswith("state.")
     }
+    paged = None
+    if meta.get("paged") is not None:
+        paged = {
+            "tables": arrays["paged.tables"],
+            "row_blocks": meta["paged"]["row_blocks"],
+            "row_shared": meta["paged"]["row_shared"],
+        }
     return {
         "format": meta["format"],
         "serve_kwargs": meta["serve_kwargs"],
@@ -570,6 +611,7 @@ def load_snapshot(path: str) -> dict:
         "queue": dec_reqs("queue", meta["queue"]),
         "next_id": meta["next_id"],
         "counters": meta["counters"],
+        "paged": paged,
     }
 
 
@@ -641,14 +683,32 @@ class PrefixHandle:
     253-258``, lifted to a cross-request shared object).
 
     Handles are bound to the server's current placement (the KV is
-    pipe-sharded per stage); build a new one after ``apply_placement``."""
+    pipe-sharded per stage); build a new one after ``apply_placement``.
 
-    __slots__ = ("kv", "n", "spx")
+    On a PAGED server the handle additionally OWNS refcounted arena blocks
+    (``blocks``): admissions map them read-only into each row's block table
+    — block-level prefix sharing, the arena stores the prefix once no
+    matter how many rows decode against it (dense mode copies the padded
+    prefix into every row's columns instead). Call
+    ``PipelineServer.release_prefix(handle)`` when done with the handle so
+    the blocks can return to the pool once the last mapping row finishes."""
 
-    def __init__(self, kv, n: int, spx: int):
+    __slots__ = ("kv", "n", "spx", "blocks", "owner")
+
+    def __init__(self, kv, n: int, spx: int, blocks=None, owner=None):
         self.kv = kv  # (k, v, pos) pipe-sharded device arrays
         self.n = n  # real prefix token count (positions resume at n)
         self.spx = spx  # padded prefix bucket — cache rows it occupies
+        self.blocks = blocks  # paged: shared arena block ids (else None)
+        # paged: WEAK ref to the allocating server — block ids are
+        # pool-LOCAL, so mapping or freeing them on another server would
+        # corrupt that server's live rows. Weak so a retained handle can't
+        # keep a dropped server's device arenas (and its _LIVE_SERVERS
+        # gauge entry) alive.
+        self.owner = None if owner is None else weakref.ref(owner)
+
+    def owned_by(self, srv) -> bool:
+        return self.owner is not None and self.owner() is srv
 
 
 class PipelineServer:
@@ -681,6 +741,8 @@ class PipelineServer:
         retryable_exceptions: tuple = (),
         snapshot_every_s: Optional[float] = None,
         snapshot_path: Optional[str] = None,
+        kv_block_size: Optional[int] = None,
+        kv_blocks: Optional[int] = None,
     ):
         self.engine = engine
         self.cfg = engine.cfg
@@ -750,6 +812,36 @@ class PipelineServer:
             )
         self.max_queue = max_queue
         self.default_deadline_s = default_deadline_s
+        # -- paged KV (PagedAttention-style block-granular serving) --------
+        # kv_block_size + kv_blocks switch the serve state from per-row
+        # dense reservations ([.., M, capacity, ..]) to a pooled arena
+        # ([.., kv_blocks, kv_block_size, ..]) with per-row block tables: a
+        # request holds only the blocks covering its prompt + budget, so
+        # skewed-length workloads admit several times more concurrent rows
+        # in the same HBM. Greedy output is token-identical to dense (the
+        # programs see the same logical window either way); dense stays the
+        # default.
+        if (kv_block_size is None) != (kv_blocks is None):
+            raise ValueError(
+                "kv_block_size and kv_blocks go together (got "
+                f"kv_block_size={kv_block_size!r}, kv_blocks={kv_blocks!r})"
+            )
+        self.paged = kv_block_size is not None
+        if self.paged:
+            kv_block_size = int(kv_block_size)
+            kv_blocks = int(kv_blocks)
+            if kv_block_size < 1 or (kv_block_size & (kv_block_size - 1)):
+                raise ValueError(
+                    f"kv_block_size must be a power of two, got "
+                    f"{kv_block_size}"
+                )
+            if kv_blocks < 2:
+                raise ValueError(
+                    f"kv_blocks must be >= 2 (block 0 is the reserved "
+                    f"trash sink), got {kv_blocks}"
+                )
+        self.kv_block_size = kv_block_size
+        self.kv_blocks = kv_blocks
         self._fault_plan = fault_plan
         if fault_retries < 0:
             raise ValueError(f"fault_retries must be >= 0, got {fault_retries}")
@@ -769,9 +861,6 @@ class PipelineServer:
         # serve_kwargs in snapshot(): an observability knob, not serving
         # state — the checkpoint format is unchanged.
         self._trace = TraceWriter(trace_path) if trace_path else None
-        _LIVE_SERVERS.add(self)  # load gauges sum over live servers
-        _update_health_gauge()  # one-hot shows SERVING from birth, not
-        # only after the first health transition
 
         from ..ops.quant import QTensor
 
@@ -798,9 +887,37 @@ class PipelineServer:
             cache_dtype=engine.cache_dtype,
             act_dtype=act_dtype,
             tp=self.tp,
+            kv_blocks=self.kv_blocks or 0,
+            kv_block_size=self.kv_block_size or 0,
         )
 
         M = self.num_stages * batch_per_slot
+        if self.paged:
+            from .blocks import BlockAllocator
+
+            self._alloc: Optional[BlockAllocator] = BlockAllocator(
+                self.kv_blocks, self.kv_block_size
+            )
+            # host mirror of the device block tables (all-trash at birth);
+            # _push_tables ships it whole — [M, T] int32 is a few hundred
+            # bytes, far below one chunk log
+            self._tables = np.zeros(
+                (M, int(self.state.block_tables.shape[1])), np.int32
+            )
+            # per-row ownership: private blocks (refcount 1, freed with the
+            # row) and shared prefix blocks (one reference per mapping row)
+            self._row_blocks: list[list[int]] = [[] for _ in range(M)]
+            self._row_shared: list[list[int]] = [[] for _ in range(M)]
+            # blocks pinned by LIVE prefix handles (prefill_prefix adds,
+            # release_prefix subtracts): admission bounds "can this request
+            # EVER fit" against capacity minus these — a pinned block can
+            # only return to the pool via release_prefix, never by waiting
+            self._handle_pins = 0
+            # host mirror edited but not yet shipped to device — releases
+            # coalesce into ONE push before the next KV-touching dispatch
+            self._tables_dirty = False
+        else:
+            self._alloc = None
         self._queue: collections.deque[Request] = collections.deque()
         self._rows: list[Optional[Request]] = [None] * M
         # HOST MIRRORS of the device bookkeeping, replayed from the per-chunk
@@ -835,6 +952,12 @@ class PipelineServer:
         # can never interleave with a mid-chunked admission (ADVICE r3 #4).
         # Re-entrant because stream() → step() runs under the same lock.
         self._mutex = threading.RLock()
+        # register LAST: a concurrent gauge sweep from another serving
+        # thread must never see a half-constructed server (_alloc,
+        # _mirror_len, _queue, _rows are all read by _update_load_gauges)
+        _LIVE_SERVERS.add(self)  # load gauges sum over live servers
+        _update_health_gauge()  # one-hot shows SERVING from birth, not
+        # only after the first health transition
 
     # ------------------------------------------------------------------ API
 
@@ -900,9 +1023,43 @@ class PipelineServer:
                     f"max_position_embeddings "
                     f"({self.cfg.max_position_embeddings})"
                 )
+        if self.paged and prefix is not None:
+            # ownership first: a foreign (or dense-built) handle's block
+            # ids don't index THIS pool, so mapping them would corrupt
+            # live rows. Then staleness: a released handle's blocks are
+            # gone even on its own server.
+            if not prefix.owned_by(self) and prefix.blocks is not None:
+                raise ValueError(
+                    "prefix handle belongs to a different server — its "
+                    "block ids index that server's KV pool, so mapping "
+                    "them here would corrupt live rows; prefill_prefix "
+                    "on THIS server"
+                )
+            if prefix.blocks is None:
+                if prefix.owner is None:
+                    raise ValueError(
+                        "prefix handle was prefilled on a DENSE server — "
+                        "it carries no KV blocks; prefill_prefix on this "
+                        "paged server instead"
+                    )
+                raise ValueError(
+                    "prefix handle was released (release_prefix) — its "
+                    "shared blocks are gone; prefill_prefix the prefix "
+                    "again before submitting suffix requests against it"
+                )
         stop = self._validate_stop(stop)
         with self._mutex:
+            # admission control first: a closed/full server must reject
+            # with the same typed ServerClosed/QueueFull (and rejection
+            # counters) in paged and dense mode alike
             self._check_admission()
+            if self.paged:
+                bucket = self._bucket(prompt.shape[0])
+                self._check_never_fits(
+                    bucket, max_new_tokens,
+                    0 if prefix is None else prefix.spx,
+                    prefix is None and self._chunked(bucket),
+                )
             req = Request(
                 self._new_id(), prompt, max_new_tokens,
                 temperature=temperature, seed=seed, top_k=top_k, top_p=top_p,
@@ -937,6 +1094,12 @@ class PipelineServer:
         if n < 1:
             raise ValueError("prefix must be non-empty")
         spx = self._bucket(n)
+        if self.paged:
+            # block-align the padded prefix so the shared blocks are
+            # exactly the table entries [0, spx/BS) and suffix writes can
+            # never land in a shared block (both are powers of two, so max
+            # is the least common multiple)
+            spx = max(spx, self.kv_block_size)
         if spx + 1 > self.capacity:
             raise ValueError(
                 f"prefix bucket ({spx}) exceeds server capacity "
@@ -959,8 +1122,23 @@ class PipelineServer:
             self.engine.cache_dtype,
             tp=self.tp,
         )
-        logger.info("prefill_prefix n=%d bucket=%d", n, spx)
-        return PrefixHandle(kv, n, spx)
+        blocks = None
+        if self.paged:
+            # the handle owns the prefix's shared blocks (refcount 1 each);
+            # their ARENA content is written by the first admission that
+            # maps them (the admit scatter broadcasts the handle KV through
+            # the row tables) — every later admission rewrites the
+            # identical values, so sharing is race-free under the device's
+            # program order. BlockExhausted propagates typed.
+            with self._mutex:
+                blocks = self._alloc.alloc(spx // self.kv_block_size)
+                self._handle_pins += len(blocks)
+                _update_load_gauges()
+        logger.info(
+            "prefill_prefix n=%d bucket=%d blocks=%s", n, spx,
+            "-" if blocks is None else len(blocks),
+        )
+        return PrefixHandle(kv, n, spx, blocks, self if blocks else None)
 
     def snapshot(self) -> dict:
         """Checkpoint the LIVE serving daemon: the full device ``ServeState``
@@ -993,6 +1171,9 @@ class PipelineServer:
                     "KV); pump until they admit or resubmit after restore"
                 )
             self._drain(0)  # flush logs so mirrors/requests are current
+            # deferred release remaps must reach the device leaf before it
+            # is captured, or restore would resurrect freed-row tables
+            self._flush_tables()
 
             def req_dict(r: Request) -> Optional[dict]:
                 if r is None:
@@ -1025,7 +1206,10 @@ class PipelineServer:
                 return d
 
             return {
-                "format": 1,
+                # format 2: adds the paged-KV section + kv serve kwargs
+                # (format-1 snapshots are dense by construction and still
+                # restore — see ``restore``)
+                "format": 2,
                 "serve_kwargs": dict(
                     capacity=self.capacity,
                     batch_per_slot=self.batch_per_slot,
@@ -1038,7 +1222,19 @@ class PipelineServer:
                     spec_ngram=self.spec_ngram,
                     max_queue=self.max_queue,
                     default_deadline_s=self.default_deadline_s,
+                    kv_block_size=self.kv_block_size,
+                    kv_blocks=self.kv_blocks,
                 ),
+                # block ownership travels with the checkpoint: restore
+                # rebuilds the allocator's free list/refcounts from the
+                # per-row lists (a prefix HANDLE's own reference dies with
+                # the process — its blocks live on exactly as long as rows
+                # still map them)
+                "paged": None if not self.paged else {
+                    "tables": self._tables.copy(),
+                    "row_blocks": [list(b) for b in self._row_blocks],
+                    "row_shared": [list(b) for b in self._row_shared],
+                },
                 "state": jax.tree.map(np.asarray, self.state._asdict()),
                 "m": self._m,
                 "sampling": self._sampling,
@@ -1064,13 +1260,35 @@ class PipelineServer:
         of an unsupported model family, raises the curated
         ``NotImplementedError`` instead of an obscure mesh/sharding error
         deep in the first dispatched program."""
-        if snap.get("format") != 1:
+        if snap.get("format") not in (1, 2):
             raise ValueError(f"unknown snapshot format {snap.get('format')!r}")
         validate = getattr(engine, "_validate_serve", None)
         if validate is not None:
             validate()
-        srv = cls(engine, **snap["serve_kwargs"])
-        host = snap["state"]
+        kwargs = dict(snap["serve_kwargs"])
+        # dense/paged are different device layouts — the mismatch gets a
+        # curated refusal up front, not a shape error deep in the leaf loop
+        paged = kwargs.get("kv_block_size") is not None
+        if paged and not snap.get("paged"):
+            raise ValueError(
+                "dense-mode snapshot cannot restore into a paged server "
+                "(no block ownership recorded): restore without "
+                "kv_block_size/kv_blocks, or re-serve and let requests "
+                "re-admit"
+            )
+        if not paged and snap.get("paged"):
+            raise ValueError(
+                "paged-mode snapshot cannot restore into a dense server: "
+                "keep the snapshot's kv_block_size/kv_blocks serve kwargs"
+            )
+        srv = cls(engine, **kwargs)
+        host = dict(snap["state"])
+        if "block_tables" not in host:
+            # legacy (format 1) snapshot: dense by construction — the
+            # placeholder leaf restores as all-trash zeros
+            host["block_tables"] = np.zeros(
+                tuple(srv.state.block_tables.shape), np.int32
+            )
         # capture (shape, dtype, sharding) then FREE the zeroed template
         # before the device_put — otherwise restore transiently holds two
         # full serving states in HBM and can OOM where serve() alone fits
@@ -1171,6 +1389,16 @@ class PipelineServer:
             srv._mirror_cachedelta[r.row] = (
                 spx + srv._bucket(r.prompt_len) - (pfx_n + r.prompt_len)
             )
+        if srv.paged:
+            pg = snap["paged"]
+            srv._tables[:] = np.asarray(pg["tables"], np.int32)
+            srv._row_blocks = [
+                [int(x) for x in b] for b in pg["row_blocks"]
+            ]
+            srv._row_shared = [
+                [int(x) for x in b] for b in pg["row_shared"]
+            ]
+            srv._alloc.restore(srv._row_blocks, srv._row_shared)
         srv._m = snap["m"]
         srv._sampling = snap["sampling"]
         srv._filtering = snap["filtering"]
@@ -1222,6 +1450,8 @@ class PipelineServer:
         stop = self._validate_stop(stop)
         with self._mutex:
             self._check_admission()
+            if self.paged:
+                self._check_never_fits(self._bucket(h.shape[0]), max_new_tokens)
             req = Request(
                 self._new_id(), np.zeros((0,), np.int32), max_new_tokens,
                 temperature=temperature, seed=seed, top_k=top_k, top_p=top_p,
@@ -1347,7 +1577,8 @@ class PipelineServer:
         record_shape_key(
             "serve_chunk",
             (self.num_stages, self.batch_per_slot, self.capacity,
-             cycles, self._sampling, self._filtering, self.tp),
+             cycles, self._sampling, self._filtering, self.tp,
+             self.kv_block_size),
         )
 
         def do_chunk():
@@ -1364,8 +1595,10 @@ class PipelineServer:
                 self._sampling,
                 self._filtering,
                 tp=self.tp,
+                block_size=self.kv_block_size or 0,
             )
 
+        self._flush_tables()
         try:
             self.state, log = self._retry(
                 "chunk_dispatch", do_chunk, real_ok=False
@@ -1525,6 +1758,7 @@ class PipelineServer:
             req.done = True
             req.finished_at = time.perf_counter()
             self._rows[req.row] = None
+            self._release_row_blocks(req.row)
             self.counters.inc("requests_cancelled")
             _update_load_gauges()
         logger.info("cancel id=%d row=%d tokens=%d", req.id, req.row,
@@ -1534,6 +1768,7 @@ class PipelineServer:
     def _cancel_rows(self, rows: list) -> None:
         # one batched dispatch no matter how many rows a cancel, deadline
         # sweep or containment event stops this step
+        self._flush_tables()
         self.state = serve_ops.cancel_rows_batched(
             self.state, rows, self.num_stages * self.batch_per_slot
         )
@@ -1614,6 +1849,128 @@ class PipelineServer:
                 f"max_queue={self.max_queue}); shed load or retry later"
             )
 
+    # ---------------------------------------------------- paged-KV internals
+
+    def _blocks_needed(
+        self, bucket: int, max_new: int, spx: int = 0, chunked: bool = False
+    ) -> int:
+        """PRIVATE blocks a request needs at admission: the columns covering
+        prefix padding + prompt bucket + decode budget (+1 for the chunked
+        path's injected final prompt token), minus the shared prefix blocks
+        the row maps read-only. Every column the device can ever really
+        write for this row is covered — garbage writes past a row's own
+        region land in trash-mapped entries, never in another row's
+        blocks."""
+        bs = self.kv_block_size
+        cover = spx + bucket + max_new + (1 if chunked else 0)
+        return -(-cover // bs) - spx // bs
+
+    def _check_never_fits(
+        self, bucket: int, max_new: int, spx: int = 0, chunked: bool = False
+    ) -> None:
+        """Typed rejection (under ``_mutex``) for a paged request that could
+        NEVER admit: transient exhaustion is a queue wait at admission time,
+        but a private-block need beyond what the pool can ever free —
+        capacity minus blocks pinned by live prefix handles, which only
+        ``release_prefix`` returns — would park at the head of the FIFO and
+        starve everything behind it."""
+        need = self._blocks_needed(bucket, max_new, spx, chunked)
+        ceiling = self._alloc.capacity_blocks - self._handle_pins
+        if need > ceiling:
+            pinned = (
+                f" minus {self._handle_pins} pinned by live prefix "
+                f"handles" if self._handle_pins else ""
+            )
+            raise ValueError(
+                f"request needs {need} KV blocks but the pool can "
+                f"free at most {ceiling} ({self.kv_blocks} blocks "
+                f"x {self.kv_block_size}{pinned}); raise kv_blocks, "
+                f"lower max_new_tokens, or release_prefix unused "
+                f"handles"
+            )
+
+    def _map_row_blocks(
+        self, row: int, bucket: int, max_new: int,
+        pfx: Optional["PrefixHandle"], chunked: bool,
+    ) -> None:
+        """Allocate a row's private blocks and build its table: shared
+        prefix blocks first (read-only, refcounted), private blocks through
+        the budget, trash everywhere else. The caller checked ``num_free``
+        before popping the request, so the alloc cannot fail here."""
+        bs = self.kv_block_size
+        spx = 0 if pfx is None else pfx.spx
+        n_pfx = spx // bs
+        priv = self._alloc.alloc(
+            self._blocks_needed(bucket, max_new, spx, chunked)
+        )
+        self._row_blocks[row] = priv
+        tbl = self._tables[row]
+        tbl[:] = 0
+        if pfx is not None:
+            self._alloc.share(pfx.blocks)
+            self._row_shared[row] = list(pfx.blocks)
+            tbl[:n_pfx] = pfx.blocks
+        tbl[n_pfx : n_pfx + len(priv)] = priv
+
+    def _release_row_blocks(self, row: int) -> None:
+        """Free a finished/cancelled/failed row's KV blocks. The host table
+        row is remapped to the trash block immediately; the DEVICE push is
+        deferred (``_tables_dirty``) and coalesced — a batch of co-admitted
+        rows finishing in one apply pass pays one transfer, not one per
+        row. Safe because a freed block can only reach a new owner through
+        ``_map_row_blocks``/``prefill_prefix``, and every KV-touching
+        program dispatch flushes the mirror first (``_flush_tables`` /
+        the admission push) — so by the time any program could write the
+        recycled block, the old row's device table already says trash."""
+        if not self.paged:
+            return
+        priv, shared = self._row_blocks[row], self._row_shared[row]
+        if not priv and not shared:
+            return
+        self._row_blocks[row] = []
+        self._row_shared[row] = []
+        self._tables[row] = 0
+        self._tables_dirty = True
+        if priv:
+            self._alloc.free(priv)
+        if shared:
+            self._alloc.free(shared)
+
+    def _push_tables(self) -> None:
+        """Ship the host block-table mirror to the device state (replicated
+        leaf — no program dispatch, just a small transfer; the next
+        dispatched program closes over the new tables)."""
+        self._tables_dirty = False
+        self.state = self.state._replace(
+            block_tables=jax.device_put(
+                self._tables, self.state.block_tables.sharding
+            )
+        )
+
+    def _flush_tables(self) -> None:
+        """Push deferred release remaps before a program dispatch."""
+        if self.paged and self._tables_dirty:
+            self._push_tables()
+
+    def release_prefix(self, handle: "PrefixHandle") -> None:
+        """Drop a paged ``prefill_prefix`` handle's own block references.
+        Rows already mapping the blocks keep them alive (refcounts); the
+        blocks return to the pool once the last such row finishes. A dense
+        handle (or a double release) is a no-op. A paged handle from a
+        DIFFERENT server is a typed error — its block ids index that
+        server's pool, so freeing them here would corrupt live rows."""
+        with self._mutex:
+            if handle.blocks and not handle.owned_by(self):
+                raise ValueError(
+                    "prefix handle belongs to a different server — "
+                    "release_prefix on the server that prefilled it"
+                )
+            blocks, handle.blocks = handle.blocks, None
+            if self.paged and blocks:
+                self._handle_pins -= len(blocks)
+                self._alloc.free(blocks)
+                _update_load_gauges()
+
     # ------------------------------------------------- resilience internals
 
     def _fault_check(self, site: str, key=None) -> None:
@@ -1664,6 +2021,7 @@ class PipelineServer:
         req.finished_at = time.perf_counter()
         if req.row is not None and self._rows[req.row] is req:
             self._rows[req.row] = None
+            self._release_row_blocks(req.row)
         self.counters.inc("requests_failed")
 
     def _contain_rows(self, site: str, victims: list, err) -> None:
@@ -1926,6 +2284,23 @@ class PipelineServer:
     def _admit_pending(self) -> bool:
         admitted = False
         for slot in self._free_slots():
+            # a queued request whose prefix handle was released AFTER
+            # submit can never admit — its shared blocks are gone. Fail it
+            # (typed, contained: consumers get RequestFailed) instead of
+            # letting _map_row_blocks crash step() on share(None).
+            while (
+                self.paged
+                and self._queue
+                and self._queue[0].prefix is not None
+                and self._queue[0].prefix.blocks is None
+            ):
+                r = self._queue.popleft()
+                self._fail_request(r, ValueError(
+                    "prefix handle was released while the request was "
+                    "queued — its shared KV blocks are gone; prefill_prefix "
+                    "again and resubmit"
+                ))
+                _update_load_gauges()
             if not self._queue:
                 break
             t_admit0 = time.perf_counter()
@@ -1944,6 +2319,29 @@ class PipelineServer:
             # rows are all seeded from one prefix KV.
             is_emb = self._queue[0].embeds is not None
             pfx = self._queue[0].prefix
+            chunked = not is_emb and pfx is None and self._chunked(bucket)
+
+            def fits(r: Request, free_left: int) -> tuple[bool, int]:
+                """Paged admission gate: a request admits only if its
+                private blocks fit the pool RIGHT NOW. Exhaustion is a
+                queue wait (FIFO preserved — head-of-line blocks the
+                admission wave), never a crash."""
+                if not self.paged:
+                    return True, free_left
+                need = self._blocks_needed(
+                    bucket, r.max_new,
+                    0 if pfx is None else pfx.spx, chunked,
+                )
+                return need <= free_left, free_left - need
+
+            free_left = self._alloc.num_free if self.paged else 0
+            ok, free_left = fits(self._queue[0], free_left)
+            if not ok:
+                logger.info(
+                    "admission waits: request %d needs more KV blocks than "
+                    "the %d free", self._queue[0].id, self._alloc.num_free,
+                )
+                break
             batch: list[Request] = [self._queue.popleft()]
             while (
                 len(batch) < Bs
@@ -1952,6 +2350,9 @@ class PipelineServer:
                 and (self._queue[0].embeds is not None) == is_emb
                 and self._queue[0].prefix is pfx
             ):
+                ok, free_left = fits(self._queue[0], free_left)
+                if not ok:
+                    break
                 batch.append(self._queue.popleft())
             prompts = np.zeros((Bs, bucket), np.int32)
             embeds = (
@@ -1994,6 +2395,12 @@ class PipelineServer:
                     (0 if pfx is None else pfx.spx) + bucket
                     - (pfx_n + r.prompt_len)
                 )
+                if self.paged:
+                    self._map_row_blocks(r.row, bucket, r.max_new, pfx, chunked)
+            if self.paged:
+                # tables must be on device BEFORE the admission dispatch —
+                # its scatter initializes exactly the blocks just mapped
+                self._push_tables()
             serve_ops.ADMIT_BUCKET_USED.labels(bucket=str(bucket)).inc()
 
             def do_admit(
@@ -2013,7 +2420,7 @@ class PipelineServer:
                     "serve_admit",
                     (self.num_stages, Bs, self.capacity, bucket, is_emb,
                      None if pfx is None else pfx.spx, self._filtering,
-                     self.tp),
+                     self.tp, self.kv_block_size),
                 )
                 self.state, tok0 = serve_ops.serve_admit(
                     self.cfg,
@@ -2042,6 +2449,7 @@ class PipelineServer:
                         None if pfx is None else jnp.asarray(pfx.n, jnp.int32)
                     ),
                     tp=self.tp,
+                    block_size=self.kv_block_size or 0,
                 )
                 # the admission-sampled first token is applied like a chunk
                 # log — deferred, so its fetch also overlaps device compute
@@ -2102,9 +2510,11 @@ class PipelineServer:
         positions[np.arange(Bs), np.maximum(plen - 1, 0)] = serve_ops.POS_SENTINEL
         record_shape_key(
             "serve_prefill_chunk",
-            (self.num_stages, Bs, self.capacity, Sc, self.tp),
+            (self.num_stages, Bs, self.capacity, Sc, self.tp,
+             self.kv_block_size),
         )
         for ci, off in enumerate(range(0, bucket, Sc)):
+            self._flush_tables()
             self.state = serve_ops.serve_prefill_chunk(
                 self.cfg,
                 self.mesh,
@@ -2119,6 +2529,7 @@ class PipelineServer:
                 jnp.asarray(ci == 0),
                 self.num_stages,
                 tp=self.tp,
+                block_size=self.kv_block_size or 0,
             )
             # interleave only when some OTHER request is mid-decode — the
             # admitting rows themselves are in _rows already and must not
@@ -2128,8 +2539,9 @@ class PipelineServer:
                     "serve_chunk",
                     (self.num_stages, self.batch_per_slot, self.capacity,
                      self.num_stages, self._sampling, self._filtering,
-                     self.tp),
+                     self.tp, self.kv_block_size),
                 )
+                self._flush_tables()
                 self.state, log = serve_ops.serve_chunk(
                     self.cfg,
                     self.mesh,
@@ -2142,6 +2554,7 @@ class PipelineServer:
                     self._sampling,
                     self._filtering,
                     tp=self.tp,
+                    block_size=self.kv_block_size or 0,
                 )
                 self._pending.append(
                     ("chunk",
@@ -2215,7 +2628,7 @@ class PipelineServer:
             record_shape_key(
                 "serve_verify",
                 (self.num_stages, Bs, self.capacity, K, self._sampling,
-                 self._filtering, self.tp),
+                 self._filtering, self.tp, self.kv_block_size),
             )
             def do_verify(slot=slot, draft=draft, draft_len=draft_len,
                           cache_delta=cache_delta):
@@ -2236,8 +2649,10 @@ class PipelineServer:
                     self._sampling,
                     self._filtering,
                     tp=self.tp,
+                    block_size=self.kv_block_size or 0,
                 )
 
+            self._flush_tables()
             try:
                 self.state, log = self._retry(
                     "chunk_dispatch", do_verify, real_ok=False
@@ -2390,6 +2805,7 @@ class PipelineServer:
             req.done = True
             req.finished_at = time.perf_counter()
             self._rows[row] = None  # slot row becomes reusable
+            self._release_row_blocks(row)
             self.counters.inc("requests_completed")
             dur = req.finished_at - (req.started_at or req.finished_at)
             queue_wait = (

@@ -199,6 +199,49 @@ def test_serve_restore_banner_reports_snapshot_flags(
     assert "ignored" in err and "--capacity 128" in err
 
 
+def test_serve_kv_flag_pairing_fast_fails(shards, capsys):
+    """An unpaired --kv-block-size/--kv-blocks fails in milliseconds,
+    BEFORE model load (same pre-load pattern as the snapshot flag pair)."""
+    rc = cli.main(["serve", shards, "--kv-block-size", "16"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "--kv-block-size" in err and "--kv-blocks" in err
+    rc = cli.main(["serve", shards, "--kv-blocks", "40"])
+    assert rc == 2
+
+
+def test_serve_paged_cli(shards, capsys, monkeypatch):
+    """--kv-block-size/--kv-blocks drive the paged-KV serve daemon end to
+    end from the CLI, with output identical to the dense daemon on the
+    same stdin prompts."""
+    from llm_sharding_tpu.runtime import engine as engine_mod
+
+    monkeypatch.setattr(
+        engine_mod.PipelineEngine,
+        "_require_tokenizer",
+        lambda self: IdTokenizer(),
+    )
+
+    def run(extra):
+        monkeypatch.setattr(
+            "sys.stdin", io.StringIO("hi paged\nsecond prompt\n")
+        )
+        rc = cli.main(
+            [
+                "serve", shards, "--max-new", "4", "--stages", "4",
+                "--capacity", "64", "--dtype", "f32", *extra,
+            ]
+        )
+        assert rc == 0
+        captured = capsys.readouterr()
+        assert '"requests_completed": 2' in captured.err
+        return [l for l in captured.out.splitlines() if l.strip()]
+
+    dense = run([])
+    paged = run(["--kv-block-size", "16", "--kv-blocks", "40"])
+    assert paged == dense and len(paged) == 2
+
+
 def test_serve_speculate_cli(shards, capsys, monkeypatch):
     """--speculate K drives the speculative serve loop end to end from the
     CLI (stdin prompt → streamed completion), and the banner still prints."""

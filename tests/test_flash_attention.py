@@ -46,40 +46,42 @@ def test_flash_ragged_block_q_padding():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
 
-def test_bucketed_decode_matches_full_capacity():
-    """Decode-shaped attention over the live-length bucket == attention over
-    the whole capacity, for lengths straddling every bucket boundary."""
-    from llm_sharding_tpu.ops.attention import bucketed_decode_attention
+def test_paged_decode_matches_full_capacity():
+    """Decode-shaped attention over only the LIVE blocks (the paged
+    successor of the retired ``bucketed_decode_attention`` — block
+    granularity instead of a lax.switch whose branch copies made it slower
+    than full capacity) == dense attention over the whole capacity, for
+    live lengths straddling block boundaries."""
+    from llm_sharding_tpu.ops.paged_attention import paged_attention_xla
 
-    B, C, Nh, Nkv, D = 2, 1024, 4, 2, 64
+    B, C, BS, Nh, Nkv, D = 2, 1024, 256, 4, 2, 64
+    T = C // BS
     k = _rand((B, C, Nkv, D), 10)
     v = _rand((B, C, Nkv, D), 11)
+    # the dense cache reinterpreted as B*T arena blocks + trash block 0:
+    # row b's logical column c lives in arena block 1 + b*T + c // BS
+    k_arena = jnp.concatenate(
+        [jnp.zeros((1, BS, Nkv, D), k.dtype), k.reshape(B * T, BS, Nkv, D)]
+    )
+    v_arena = jnp.concatenate(
+        [jnp.zeros((1, BS, Nkv, D), v.dtype), v.reshape(B * T, BS, Nkv, D)]
+    )
     for live in (3, 255, 256, 257, 600, 1023):
         q = _rand((B, 1, Nh, D), 12 + live)
         q_pos = jnp.full((B, 1), live, jnp.int32)
         kv_pos = jnp.where(jnp.arange(C) <= live, jnp.arange(C), POS_SENTINEL)
         kv_pos = jnp.broadcast_to(kv_pos[None], (B, C)).astype(jnp.int32)
         want = cached_attention(q, k, v, q_pos, kv_pos)
-        got = bucketed_decode_attention(
-            q, k, v, q_pos, kv_pos, jnp.int32(live)
+        # map only the blocks covering the live prefix; the rest stay on
+        # the trash block, masked by the sentinel kv positions
+        n_live = live // BS + 1
+        tbl = np.zeros((B, T), np.int32)
+        for b in range(B):
+            tbl[b, :n_live] = 1 + b * T + np.arange(n_live)
+        got = paged_attention_xla(
+            q, k_arena, v_arena, jnp.asarray(tbl), q_pos, kv_pos
         )
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
-
-
-def test_bucketed_decode_small_capacity_passthrough():
-    """Capacity at/below the min bucket degrades to plain cached_attention."""
-    from llm_sharding_tpu.ops.attention import bucketed_decode_attention
-
-    B, C, Nh, Nkv, D = 1, 64, 2, 2, 32
-    q = _rand((B, 1, Nh, D), 20)
-    k = _rand((B, C, Nkv, D), 21)
-    v = _rand((B, C, Nkv, D), 22)
-    q_pos = jnp.full((B, 1), 10, jnp.int32)
-    kv_pos = jnp.where(jnp.arange(C) <= 10, jnp.arange(C), POS_SENTINEL)
-    kv_pos = jnp.broadcast_to(kv_pos[None], (B, C)).astype(jnp.int32)
-    want = cached_attention(q, k, v, q_pos, kv_pos)
-    got = bucketed_decode_attention(q, k, v, q_pos, kv_pos, jnp.int32(10))
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
 
 def test_flash_with_padded_rows():

@@ -76,6 +76,24 @@ def test_dp_serve_privacy_entry(setup):
     assert r.tokens == oracle(params, p, 8)
 
 
+def test_dp_prefix_prefill_and_release(setup):
+    """prefill_prefix fans out per replica; release_prefix releases every
+    per-replica handle (the paged never-fits ceiling depends on it) and
+    rejects a non-replicated handle typed."""
+    params, srv = setup
+    rng = np.random.default_rng(3)
+    pfx = rng.integers(1, CFG.vocab_size, 8).astype(np.int32)
+    sfx = rng.integers(1, CFG.vocab_size, 3).astype(np.int32)
+    h = srv.prefill_prefix(pfx)
+    r = srv.submit(sfx, 6, prefix=h)
+    srv.run_until_idle()
+    assert r.tokens == oracle(params, np.concatenate([pfx, sfx]), 6)
+    srv.release_prefix(h)
+    assert all(lh.blocks is None for lh in h.per_server.values())
+    with pytest.raises(ValueError, match="ReplicatedPrefixHandle"):
+        srv.release_prefix(h.per_server[srv.servers[0]])
+
+
 def test_dp_devices_not_divisible_rejected():
     params = llama.init_params(CFG, jax.random.key(5), dtype=jnp.float32)
     with pytest.raises(ValueError, match="divisible"):

@@ -196,6 +196,93 @@ def test_snapshot_is_read_only_on_request_ids(setup):
     srv.run_until_idle()
 
 
+def test_paged_snapshot_restore_mid_decode_token_exact(setup, tmp_path):
+    """Paged-mode daemon snapshotted mid-decode, saved to disk, restored:
+    in-flight requests finish token-exactly AND the block allocator is
+    rebuilt from the snapshot's per-row ownership lists (invariant holds,
+    every block comes home on drain)."""
+    params, eng = setup
+    srv = eng.serve(capacity=64, kv_block_size=16, kv_blocks=24)
+    rng = np.random.default_rng(71)
+    pa = rng.integers(1, CFG.vocab_size, 5).astype(np.int32)
+    pb = rng.integers(1, CFG.vocab_size, 3).astype(np.int32)
+    ra = srv.submit(pa, max_new_tokens=14)
+    rb = srv.submit(pb, max_new_tokens=10)
+    for _ in range(4):
+        srv.step()
+    snap = srv.snapshot()
+    assert snap["format"] == 2 and snap["paged"] is not None
+    import tempfile
+
+    d = tempfile.mkdtemp(dir=tmp_path)
+    save_snapshot(snap, d)
+    srv2 = PipelineServer.restore(eng, load_snapshot(d))
+    assert srv2.paged and srv2.kv_block_size == 16
+    srv2._alloc.check()
+    assert srv2._alloc.in_use == srv._alloc.in_use > 0
+    restored = {
+        r.id: r for r in srv2._rows + list(srv2._queue) if r is not None
+    }
+    srv2.run_until_idle()
+    assert restored[ra.id].tokens == oracle(params, pa, 14)
+    assert restored[rb.id].tokens == oracle(params, pb, 10)
+    srv2._alloc.check()
+    assert srv2._alloc.in_use == 0
+
+
+def test_dense_snapshot_refuses_paged_server(setup):
+    """Mode mismatch is a curated refusal, not a shape error: a dense
+    snapshot carries no block ownership, so a paged restore target must
+    reject it up front."""
+    _, eng = setup
+    srv = eng.serve(capacity=64)
+    snap = srv.snapshot()
+    assert snap["paged"] is None
+    snap["serve_kwargs"]["kv_block_size"] = 16
+    snap["serve_kwargs"]["kv_blocks"] = 24
+    with pytest.raises(ValueError, match="dense-mode snapshot"):
+        PipelineServer.restore(eng, snap)
+
+
+def test_paged_snapshot_refuses_dense_server(setup):
+    _, eng = setup
+    srv = eng.serve(capacity=64, kv_block_size=16, kv_blocks=24)
+    snap = srv.snapshot()
+    snap["serve_kwargs"]["kv_block_size"] = None
+    snap["serve_kwargs"]["kv_blocks"] = None
+    with pytest.raises(ValueError, match="paged-mode snapshot"):
+        PipelineServer.restore(eng, snap)
+
+
+def test_legacy_format1_snapshot_still_restores(setup):
+    """A pre-paged (format 1) snapshot — no block_tables leaf, no paged
+    section, no kv serve kwargs — restores into a dense server and its
+    requests complete token-exactly."""
+    params, eng = setup
+    srv = eng.serve(capacity=64)
+    rng = np.random.default_rng(73)
+    p = rng.integers(1, CFG.vocab_size, 4).astype(np.int32)
+    r = srv.submit(p, max_new_tokens=10)
+    for _ in range(3):
+        srv.step()
+    snap = srv.snapshot()
+    # rewrite as a format-1 era snapshot
+    snap["format"] = 1
+    snap["paged"] = None
+    snap["state"] = {
+        k: v for k, v in snap["state"].items() if k != "block_tables"
+    }
+    for k in ("kv_block_size", "kv_blocks"):
+        snap["serve_kwargs"].pop(k, None)
+    srv2 = PipelineServer.restore(eng, snap)
+    got = next(
+        x for x in srv2._rows + list(srv2._queue)
+        if x is not None and x.id == r.id
+    )
+    srv2.run_until_idle()
+    assert got.done and got.tokens == oracle(params, p, 10)
+
+
 def test_restore_runs_engine_serve_validation(setup):
     """restore() applies the same engine guards serve() does (ADVICE r5):
     an in-program-dp engine gets the curated NotImplementedError pointing
