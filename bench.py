@@ -492,6 +492,86 @@ def bench_fault_serve(on_tpu, engine):
     )
 
 
+def bench_failover_serve(on_tpu, cfg, params, jax, jnp):
+    """Throughput DURING a replica failover vs the clean dp run. A seeded
+    ``replica_step`` fault kills replica 0 mid-decode; the supervision
+    layer (runtime/replicated.py) quarantines it, migrates its live rows to
+    the survivor through the portable extract/adopt path, and the workload
+    finishes there. The faulted run must stay token-identical to the clean
+    dp run (greedy migration re-prefills prompt+generated — exact by the
+    same argument as chunked prefill), so the emitted ratio is pure
+    failover cost: detection + migration re-prefills + the lost replica's
+    capacity for the remainder of the run."""
+    from llm_sharding_tpu.obs.metrics import REQUESTS_MIGRATED
+    from llm_sharding_tpu.runtime.faults import FaultPlan
+    from llm_sharding_tpu.runtime.replicated import ReplicatedServer
+
+    name = (
+        "serve_failover_tok_s_llama3.2-3b_dp2" if on_tpu
+        else "serve_failover_tok_s_tiny_cpu"
+    )
+    if on_tpu:
+        stages, n_req, prompt_len, max_new, kill_step = 1, 16, 32, 128, 6
+    else:
+        stages, n_req, prompt_len, max_new, kill_step = 2, 6, 8, 16, 3
+    n_dev = len(jax.devices())
+    if n_dev < 2 * stages:
+        emit_error(name, "tokens/sec",
+                   f"needs >= {2 * stages} devices for dp2 x {stages} "
+                   f"stage(s) (have {n_dev})")
+        return
+    devices = jax.devices()[: 2 * stages]
+
+    def run(plan):
+        srv = ReplicatedServer(
+            cfg, params, data_parallel=2, num_stages=stages,
+            devices=devices, capacity=320 if on_tpu else 64,
+            fault_plan=plan,
+        )
+        rng = np.random.default_rng(13)
+        prompts = [
+            rng.integers(0, cfg.vocab_size, prompt_len).astype(np.int32)
+            for _ in range(n_req)
+        ]
+        reqs = [srv.submit(p, max_new_tokens=max_new) for p in prompts]
+        t0 = time.perf_counter()
+        srv.run_until_idle()
+        dt = time.perf_counter() - t0
+        toks = [list(r.tokens) for r in reqs]
+        assert all(r.error is None for r in reqs), [
+            (r.id, r.error) for r in reqs if r.error is not None
+        ]
+        n_live = len(srv.servers)
+        srv.close()
+        del srv
+        gc.collect()
+        return sum(len(t) for t in toks) / dt, toks, n_live
+
+    run(None)  # compile admit + chunk programs for both replicas
+    clean_tok_s, clean_toks, _ = run(None)
+    migrated0 = REQUESTS_MIGRATED.labels(outcome="ok").value
+    plan = FaultPlan.permanent("replica_step", key=0, start=kill_step)
+    fault_tok_s, fault_toks, n_live = run(plan)
+    migrated = int(REQUESTS_MIGRATED.labels(outcome="ok").value - migrated0)
+    if fault_toks != clean_toks:
+        # loud failure, not a buried extras field: migration re-admits with
+        # identical context, so any divergence means the failover path
+        # broke exactness — the headline must not ship
+        raise RuntimeError(
+            "failover serve output diverged from the clean run "
+            f"({sum(len(t) for t in fault_toks)} vs "
+            f"{sum(len(t) for t in clean_toks)} tokens)"
+        )
+    emit(
+        name, fault_tok_s, "tokens/sec", fault_tok_s / ANCHOR_TOK_S,
+        clean_tok_s=round(clean_tok_s, 2),
+        recovered_frac=round(fault_tok_s / max(clean_tok_s, 1e-9), 3),
+        requests_migrated=migrated,
+        replicas_after=n_live,
+        token_identical=(fault_toks == clean_toks),
+    )
+
+
 def bench_paged_serve(on_tpu, engine):
     """Paged KV serving (runtime/blocks.py + ops/paged_attention.py) on a
     SKEWED-length workload at EQUAL HBM budget. Dense reserves ``capacity``
@@ -849,6 +929,10 @@ def main():
         "serve_fault_recovery_tok_s_llama3.2-3b_1stage" if on_tpu
         else "serve_fault_recovery_tok_s_tiny_cpu"
     )
+    nfailover = (
+        "serve_failover_tok_s_llama3.2-3b_dp2" if on_tpu
+        else "serve_failover_tok_s_tiny_cpu"
+    )
     npaged = (
         "serve_tok_s_paged_llama3.2-3b_1stage" if on_tpu
         else "serve_tok_s_paged_tiny_cpu"
@@ -920,6 +1004,17 @@ def main():
                 bench_fault_serve(on_tpu, serve_engine)
             except Exception as e:  # noqa: BLE001
                 emit_error(nfault, "tokens/sec", e)
+        # replica failover (dp2 supervision: kill one replica mid-decode,
+        # throughput through migration vs clean) builds its OWN replica
+        # engines from params3b — run before int8 donates those buffers
+        if remaining() < 150:
+            emit_skip(nfailover, "tokens/sec", 150)
+        else:
+            try:
+                bench_failover_serve(on_tpu, cfg3b, params3b, jax, jnp)
+            except Exception as e:  # noqa: BLE001
+                emit_error(nfailover, "tokens/sec", e)
+            gc.collect()
         del serve_engine
         gc.collect()
         # speculative decode BEFORE int8: it reuses the live bf16 device
@@ -981,6 +1076,8 @@ def main():
     else:
         emit_error(nserve, "tokens/sec", "not attempted: 3B section failed")
         emit_error(npaged, "tokens/sec", "not attempted: 3B section failed")
+        emit_error(nfailover, "tokens/sec",
+                   "not attempted: 3B section failed")
         emit_error(nprefix, "x_speedup_vs_full_prefill",
                    "not attempted: 3B section failed")
         emit_error(nspec, "tokens/sec", "not attempted: 3B section failed")

@@ -273,11 +273,74 @@ def _serve_control(eng, srv, line: str, args):
     return srv
 
 
+def _dp_serve_control(srv, line: str):
+    """dp daemon control lines (the elasticity surface of the replica
+    supervision layer, ``runtime/replicated.py``):
+
+    - ``:drain N``   — migrate every live request off replica N (device-
+      group index, see ``:stats``) to the others and close it; refused
+      below ``--min-replicas``. Scale-down drops zero streams.
+    - ``:spawn``     — bring a fresh replica up on the lowest freed device
+      group (weights re-staged from the shared host arrays).
+    - ``:counters`` / ``:stats`` — as on the single-engine daemon, plus
+      per-replica health/load/KV entries.
+
+    Returns the server (the dp router object is never swapped)."""
+    from .obs.metrics import REGISTRY
+
+    parts = line.split(None, 1)
+    cmd = parts[0]
+    if cmd == ":counters":
+        print(json.dumps(srv.counters.snapshot()), file=sys.stderr)
+    elif cmd == ":stats":
+        # the router's full view (aggregate counters, per-replica entries,
+        # offline_groups — the ':spawn' decision input) + the registry
+        print(
+            json.dumps(
+                {**srv.stats(), "metrics": REGISTRY.json_snapshot()},
+                sort_keys=True,
+            ),
+            file=sys.stderr,
+        )
+    elif cmd == ":drain":
+        if len(parts) < 2:
+            print("usage: :drain N  (replica device-group index)",
+                  file=sys.stderr)
+            return srv
+        try:
+            moved = srv.drain(int(parts[1]))
+            print(
+                f"replica {int(parts[1])} drained: {moved} request(s) "
+                f"migrated; {len(srv.servers)} replica(s) live",
+                file=sys.stderr,
+            )
+        except (ValueError, RuntimeError) as e:
+            print(f"drain failed: {e}", file=sys.stderr)
+    elif cmd == ":spawn":
+        try:
+            s = srv.spawn_replica()
+            print(
+                f"replica spawned on group {srv._group_of[s]}; "
+                f"{len(srv.servers)} replica(s) live",
+                file=sys.stderr,
+            )
+        except (ValueError, RuntimeError) as e:
+            print(f"spawn failed: {e}", file=sys.stderr)
+    else:
+        print(
+            f"unknown control line {cmd!r} (dp daemon: :drain N, :spawn, "
+            ":counters, :stats)",
+            file=sys.stderr,
+        )
+    return srv
+
+
 def cmd_serve(args) -> int:
     """Interactive persistent daemon: one prompt per stdin line, streamed
     completion per line (≙ the reference's forever-spinning worker loop).
     Lines starting with ``:`` are operator control commands — see
-    ``_serve_control`` (hot repartition without restarting the daemon)."""
+    ``_serve_control`` (hot repartition without restarting the daemon) and
+    ``_dp_serve_control`` (replica drain/spawn on the dp daemon)."""
     from .runtime.server import QueueFull, RequestFailed, ServerClosed
 
     # fail the flag mismatch in milliseconds, not after minutes of model
@@ -340,12 +403,13 @@ def cmd_serve(args) -> int:
             snapshot_path=args.snapshot_dir,
             kv_block_size=args.kv_block_size or None,
             kv_blocks=args.kv_blocks or None,
+            min_replicas=getattr(args, "min_replicas", 1),
         )
         eng = srv.engines[0]
         print(
             f"serving {eng.cfg.model_type}: {args.data_parallel} replicas x "
             f"{eng.mesh.shape} (capacity={args.capacity}); enter a prompt, "
-            "^D to exit",
+            "^D to exit; :drain N / :spawn resize the replica set live",
             file=sys.stderr,
         )
     else:
@@ -467,8 +531,7 @@ def cmd_serve(args) -> int:
             continue
         if prompt.startswith(":"):
             if getattr(args, "data_parallel", 1) > 1:
-                print("control lines are single-engine only (dp daemon)",
-                      file=sys.stderr)
+                srv = _dp_serve_control(srv, prompt)
             else:
                 srv = _serve_control(eng, srv, prompt, args)
             continue
@@ -837,6 +900,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--tensor-parallel", type=int, default=1, dest="tensor_parallel",
         help="megatron tensor parallelism per pipeline (composes with "
         "--stages and --data-parallel: devices = dp x stages x tp)",
+    )
+    s.add_argument(
+        "--min-replicas", type=int, default=1, dest="min_replicas",
+        help="with --data-parallel: refuse ':drain N' (and report it) when "
+        "fewer than this many replicas would remain live — the elasticity "
+        "floor of the replica supervision layer",
     )
     s.add_argument(
         "--prefill-chunk", type=int, default=None, dest="prefill_chunk",

@@ -441,6 +441,63 @@ KV_WASTE_FRAC = REGISTRY.gauge(
 )
 
 
+# -- replica supervision (runtime/replicated.py) ----------------------------
+# Defined here like the KV gauges: the failover/migration counters and the
+# per-replica state gauge exist — and show 0 / no series — before the first
+# ReplicatedServer is constructed, so /statz and :stats always carry them.
+REPLICA_FAILOVERS = REGISTRY.counter(
+    "server_replica_failovers_total",
+    "Replicas the router classified as FAILED (step raised, or containment "
+    "events crossed the failure threshold inside the window) and failed "
+    "over: quarantined, live requests migrated to survivors, then closed",
+)
+REPLICA_DRAINS = REGISTRY.counter(
+    "server_replica_drains_total",
+    "Elective replica drains (stop admitting, migrate every live request "
+    "out, close): the scale-down half of dp elasticity",
+)
+REPLICA_SPAWNS = REGISTRY.counter(
+    "server_replica_spawns_total",
+    "Replicas spawned onto a freed device group (weights re-staged from "
+    "the shared host arrays): the scale-up half of dp elasticity",
+)
+REQUESTS_MIGRATED = REGISTRY.counter(
+    "server_requests_migrated_total",
+    "Live requests moved between replicas during failover/drain, by "
+    "outcome (ok = re-admitted on a survivor with its stream intact, "
+    "failed = no survivor could adopt it — the request fails typed)",
+    labels=("outcome",),
+)
+
+#: Router-level per-replica states: the three server health states, plus
+#: QUARANTINED (classified failed; migration in progress) and OFFLINE (no
+#: live replica on the device group — drained/failed-over, spawnable).
+REPLICA_STATES = (
+    "SERVING", "DEGRADED", "DRAINING", "QUARANTINED", "OFFLINE",
+)
+REPLICA_STATE = REGISTRY.gauge(
+    "server_replica_state",
+    "Per-replica supervision state, one-hot per replica label (the replica "
+    "label is the device-group index, stable across drain/spawn cycles): "
+    "exactly one state is 1 for each replica",
+    labels=("replica", "state"),
+)
+
+
+def set_replica_state(replica, state: str) -> None:
+    """One-hot flip of ``server_replica_state`` for one replica label (the
+    per-replica analogue of ``StateGauge.set_state`` — a labeled StateGauge
+    per replica would need dynamic registration; this keeps one family)."""
+    if state not in REPLICA_STATES:
+        raise ValueError(
+            f"unknown replica state {state!r}; expected one of "
+            f"{REPLICA_STATES}"
+        )
+    r = str(replica)
+    for s in REPLICA_STATES:
+        REPLICA_STATE.labels(replica=r, state=s).set(1.0 if s == state else 0.0)
+
+
 # -- compile/shape-key visibility -----------------------------------------
 
 _SHAPE_KEYS_SEEN: set = set()

@@ -400,6 +400,9 @@ def serve_admit(
     filtering: bool = True,  # static: compile top-k/top-p machinery
     prefix_kv: Any = None,  # (k, v, pos) from prefix_prefill — prefix caching
     prefix_len: Any = None,  # scalar int32 real prefix length
+    key_override: Any = None,  # ([Bs, 2] uint32 carried chains, [Bs] bool
+    #   mask): migrated rows resume their sampling chain mid-stream — see
+    #   the key-chain note below
     tp: int = 1,  # static: tensor-parallel degree (megatron-sharded heads)
     block_size: int = 0,  # static: paged-KV block size (0 = dense state)
 ):
@@ -429,7 +432,15 @@ def serve_admit(
     With ``prefix_kv`` (a ``prefix_prefill`` result) the slot's cache rows
     are SEEDED with the shared prefix's keys/values — ``prompts`` carries
     only each request's suffix, at absolute positions ``prefix_len + i``,
-    and the prefix's prefill compute is never repeated (prefix caching)."""
+    and the prefix's prefill compute is never repeated (prefix caching).
+
+    Key-chain note (``key_override``): a row resuming a MIGRATED sampled
+    request carries the chain its source replica would hold after the
+    tokens already streamed — ``t`` splits of ``key(seed)``. For masked
+    rows the admission draws ``tok0`` from ``split(carried)`` (the exact
+    draw the unfaulted run would make for token ``t+1``) and stores the
+    advanced chain; unmasked rows walk the fresh ``seed_chain_init`` chain
+    unchanged, so carried and fresh requests co-admit in one batch."""
     fns = model_fns(cfg, tp_axis=TENSOR_AXIS if tp > 1 else None)
     Bs, Sp = prompts.shape
     nkv = cfg.num_key_value_heads // tp  # heads LOCAL to a tensor shard
@@ -438,7 +449,8 @@ def serve_admit(
 
     def body(stage_layers, layer_mask, head_params, state, prompts,
              prompt_len, row_valid, slot, max_new, seeds, temperature,
-             top_k, top_p, prompt_embeds, prefix_kv, prefix_len):
+             top_k, top_p, prompt_embeds, prefix_kv, prefix_len,
+             key_override):
         layers = jax.tree.map(lambda a: a[0], stage_layers)
         lmask = layer_mask[0]
         hd = local_view(head_params)
@@ -501,6 +513,13 @@ def serve_admit(
         # sample), so a seeded temperature>0 request draws the monolith's
         # B=1 tokens exactly (r2 weak #8).
         row_keys, subs = seed_chain_init(seeds)  # [Bs, 2] each
+        if key_override is not None:
+            # migrated rows: one split of the carried chain yields exactly
+            # the (stored, sub) pair the unfaulted run's next commit would
+            ko, ko_mask = key_override
+            ck, cs = key_chain_split(ko)
+            row_keys = jnp.where(ko_mask[:, None], ck, row_keys)
+            subs = jnp.where(ko_mask[:, None], cs, subs)
         tok0 = sp_sample_rows(
             cfg, hd, h_last, subs, temperature, top_k, top_p, num_stages,
             filtering=filtering,
@@ -609,12 +628,13 @@ def serve_admit(
             P(PIPE_AXIS) if prefix_kv is None
             else (specs.k, specs.v, P(PIPE_AXIS)),
             P(),
+            P(),  # key_override: replicated (leafless no-op when None)
         ),
         out_specs=(specs, P()),
         check_vma=False,
     )(stage_layers, layer_masks, head_params, state, prompts, prompt_len,
       row_valid, slot, max_new, seeds, temperature, top_k, top_p,
-      prompt_embeds, prefix_kv, prefix_len)
+      prompt_embeds, prefix_kv, prefix_len, key_override)
     return out_state, tok0
 
 
@@ -753,6 +773,7 @@ def serve_admit_finish(
     top_p: jnp.ndarray,       # [Bs] f32 (1.0 → off)
     num_stages: int,
     tp: int = 1,
+    key_override: Any = None,  # ([Bs, 2] uint32, [Bs] bool) — see below
 ):
     """Arm a chunk-prefilled slot: park each row's final prompt token in the
     injection path at position ``prompt_len - 1``. The slot's first
@@ -763,11 +784,15 @@ def serve_admit_finish(
 
     Key-chain note: the stored per-row key is UNSPLIT (``key(seed)``); the
     first commit in ``serve_chunk`` performs the first split — the same
-    chain the monolith walks, so seeded sampling stays token-exact."""
+    chain the monolith walks, so seeded sampling stays token-exact. With
+    ``key_override``, masked rows store the CARRIED chain instead (a
+    migrated request resuming mid-stream: ``t`` splits of ``key(seed)``) —
+    the next commit's split then yields draw ``t+1``, exactly where the
+    source replica's chain stood."""
     Bs = last_tok.shape[0]
 
     def body(head_params, state, last_tok, prompt_len, row_valid, slot,
-             max_new, seeds, temperature, top_k, top_p):
+             max_new, seeds, temperature, top_k, top_p, key_override):
         hd = local_view(head_params)
         sidx = jax.lax.axis_index(PIPE_AXIS)
         st = jax.tree.map(
@@ -799,6 +824,9 @@ def serve_admit_finish(
         row_keys = jax.vmap(
             lambda s: jax.random.key_data(jax.random.key(s))
         )(seeds)
+        if key_override is not None:
+            ko, ko_mask = key_override
+            row_keys = jnp.where(ko_mask[:, None], ko, row_keys)
         rng = jax.lax.dynamic_update_slice_in_dim(
             st.rng, row_keys, row0, axis=0
         )
@@ -832,11 +860,12 @@ def serve_admit_finish(
         in_specs=(
             head_specs(head_params), specs,
             P(), P(), P(), P(), P(), P(), P(), P(), P(),
+            P(),  # key_override: replicated (leafless no-op when None)
         ),
         out_specs=specs,
         check_vma=False,
     )(head_params, state, last_tok, prompt_len, row_valid, slot, max_new,
-      seeds, temperature, top_k, top_p)
+      seeds, temperature, top_k, top_p, key_override)
 
 
 @functools.partial(

@@ -48,6 +48,10 @@ SITES = (
     "log_fetch",       # consuming one prefetched device→host log read
     "request_apply",   # one committed token application (keyed by req id)
     "snapshot_write",  # one auto-snapshot write
+    "replica_step",    # one router-driven replica step (keyed by the
+    #                    replica's device-group index) — a permanent fault
+    #                    here simulates the whole replica vanishing and
+    #                    drives the ReplicatedServer failover path
 )
 
 

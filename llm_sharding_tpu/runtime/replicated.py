@@ -1,4 +1,5 @@
-"""Data-parallel continuous batching: replica servers behind a router.
+"""Data-parallel continuous batching: replica servers behind a SUPERVISED
+router.
 
 VERDICT r3 next-#5 — serving on dp hybrids. The TPU-idiomatic shape of data
 parallelism for a SERVING daemon is not one giant SPMD program with a data
@@ -20,10 +21,42 @@ Properties:
 - failure isolation: a replica's device state cannot corrupt another's;
 - aggregate throughput ≈ D × one replica (replicas dispatch to disjoint
   devices; JAX async dispatch runs them concurrently).
+
+Replica SUPERVISION (the layer that turns D independent replicas into one
+endpoint that survives losing any of them — where the reference dies with
+any single device in its chain):
+
+- **failure detection**: the router watches each replica for (a) a
+  ``step()`` that raises (including an injected ``replica_step`` fault —
+  ``runtime/faults.py`` — keyed by the replica's device-group index) and
+  (b) containment events (``PipelineServer.containment_events``) crossing
+  ``failure_threshold`` inside ``failure_window_s``;
+- **failover**: a failed replica is QUARANTINED (no new admissions, no
+  more steps), every live row and queued request is ``extract``ed as
+  host-side ``RequestState`` and ``adopt``ed onto survivors — greedy
+  continuation is token-identical to an unfaulted run, sampled
+  continuation resumes from the carried rng chain, prefix-bound rows
+  re-resolve their local handle through the
+  ``ReplicatedPrefixHandle.per_server`` map; a request no survivor can
+  take fails with the existing typed ``RequestFailed``. The dead replica
+  is then closed and its device group freed;
+- **elasticity**: ``drain(d)`` electively migrates a replica's work out
+  and closes it (scale-down drops zero streams); ``spawn_replica()``
+  brings a fresh engine+server up on a freed group, re-staging weights
+  from the shared host arrays (scale-up); ``min_replicas`` guards drain;
+- **health-aware routing**: ``_pick`` only routes to SERVING replicas
+  while any exist, falling back in severity order otherwise;
+- **observability**: ``server_replica_failovers/drains/spawns_total``,
+  ``server_requests_migrated_total{outcome}`` and the per-replica one-hot
+  ``server_replica_state{replica,state}`` gauge (``obs/metrics.py``).
 """
 
 from __future__ import annotations
 
+import collections
+import logging
+import threading
+import time
 import weakref
 from typing import Any, Iterator, Optional
 
@@ -31,18 +64,32 @@ import numpy as np
 import jax
 
 from ..models.config import ModelConfig
+from ..obs.metrics import (
+    REPLICA_DRAINS, REPLICA_FAILOVERS, REPLICA_SPAWNS, REQUESTS_MIGRATED,
+    set_replica_state,
+)
 from ..parallel.placement import PlacementSpec
 
 from .engine import PipelineEngine
-from .server import PipelineServer, PrefixHandle, Request
+from .faults import is_transient
+from .server import (
+    PipelineServer, PrefixHandle, Request, RequestFailed, ServerClosed,
+    _HEALTH_SEVERITY,
+)
+
+logger = logging.getLogger("llm_sharding_tpu.replicated")
 
 
 class ReplicatedPrefixHandle:
     """A shared prefix prefilled on EVERY replica (each replica's handle
     lives on its own device group). ``submit(prefix=...)`` resolves it to
-    the routed replica's local handle."""
+    the routed replica's local handle.
 
-    __slots__ = ("per_server",)
+    Replicas spawned AFTER the handle was built are not covered by it —
+    the router routes covered requests only among covered replicas, and a
+    migration targeting an uncovered replica skips it."""
+
+    __slots__ = ("per_server", "__weakref__")
 
     def __init__(self, per_server: dict):
         # keyed by the server OBJECT (not id()): keeps the replicas the
@@ -52,8 +99,10 @@ class ReplicatedPrefixHandle:
 
 
 class ReplicatedServer:
-    """D replica ``PipelineServer``s over disjoint device groups + a least-
-    loaded router. The public surface mirrors ``PipelineServer``."""
+    """D replica ``PipelineServer``s over disjoint device groups + a
+    health-aware least-loaded router with replica supervision (failure
+    detection, live request migration, drain/spawn elasticity). The public
+    surface mirrors ``PipelineServer``."""
 
     def __init__(
         self,
@@ -67,12 +116,28 @@ class ReplicatedServer:
         devices: Optional[list] = None,
         tokenizer: Any = None,
         cache_dtype=None,
+        failure_threshold: int = 3,
+        failure_window_s: float = 60.0,
+        min_replicas: int = 1,
         **serve_kwargs,
     ):
         import jax.numpy as jnp
 
         if data_parallel < 1:
             raise ValueError("data_parallel must be >= 1")
+        if failure_threshold < 1:
+            raise ValueError(
+                f"failure_threshold must be >= 1, got {failure_threshold}"
+            )
+        if failure_window_s <= 0:
+            raise ValueError(
+                f"failure_window_s must be > 0, got {failure_window_s}"
+            )
+        if not 0 <= min_replicas <= data_parallel:
+            raise ValueError(
+                f"min_replicas must be in [0, data_parallel], got "
+                f"{min_replicas} with data_parallel={data_parallel}"
+            )
         devices = list(devices if devices is not None else jax.devices())
         if len(devices) % data_parallel:
             raise ValueError(
@@ -82,73 +147,172 @@ class ReplicatedServer:
         group = len(devices) // data_parallel
         # host-stage the weights ONCE; every replica engine receives the same
         # numpy arrays (its np.asarray staging is then a no-op) and
-        # device_puts onto its own group only
-        host_params = jax.tree.map(np.asarray, params)
-        # one JSONL trace file PER REPLICA (suffix .r<d>): replicas step on
-        # independent threads of control — a shared file would interleave
-        # their spans with no way to attribute them
-        trace_path = serve_kwargs.pop("trace_path", None)
+        # device_puts onto its own group only. KEPT for the daemon's
+        # lifetime: spawn_replica re-stages a fresh replica from them.
+        self._host_params = jax.tree.map(np.asarray, params)
+        # one JSONL trace file PER REPLICA (suffix .r<d>, d = device-group
+        # index): replicas step on independent threads of control — a shared
+        # file would interleave their spans with no way to attribute them
+        self._trace_path = serve_kwargs.pop("trace_path", None)
         # auto-snapshots likewise: one directory per replica, or D daemons
         # would race the same atomic rename
-        snapshot_path = serve_kwargs.pop("snapshot_path", None)
+        self._snapshot_path = serve_kwargs.pop("snapshot_path", None)
+        self._cfg = cfg
+        self._num_stages = num_stages
+        self._tp = tensor_parallel
+        self._placement = placement
+        self._tokenizer = tokenizer
+        self._cache_dtype = cache_dtype or jnp.bfloat16
+        self._serve_kwargs = dict(serve_kwargs)
+        # the router shares the replicas' fault plan for the replica-level
+        # crash site (``replica_step``, keyed by device-group index)
+        self._fault_plan = serve_kwargs.get("fault_plan")
+        self.failure_threshold = int(failure_threshold)
+        self.failure_window_s = float(failure_window_s)
+        self.min_replicas = int(min_replicas)
+        self.data_parallel = data_parallel
+        # fixed device groups; the group index is the replica's stable
+        # identity across drain/spawn cycles (metrics label, CLI :drain N)
+        self._groups = [
+            devices[d * group : (d + 1) * group] for d in range(data_parallel)
+        ]
         self.engines: list[PipelineEngine] = []
         self.servers: list[PipelineServer] = []
+        self._by_group: dict[int, PipelineServer] = {}
+        self._group_of: dict[PipelineServer, int] = {}
+        self._failures: dict[PipelineServer, collections.deque] = {}
+        self._seen_contained: dict[PipelineServer, int] = {}
+        self._gauge_state: dict[int, str] = {}
+        # one lock serializes router mutations (routing tables, ownership,
+        # the servers list) against each other — a cancel can never observe
+        # a request mid-migration. Re-entrant: stream() → step() → failover.
+        self._lock = threading.RLock()
+        # live replicated prefix handles: migration re-resolves a request's
+        # source-local handle to the target's through these (weak: handles
+        # die with their callers)
+        self._rhandles: "weakref.WeakSet[ReplicatedPrefixHandle]" = (
+            weakref.WeakSet()
+        )
         for d in range(data_parallel):
-            eng = PipelineEngine(
-                cfg,
-                host_params,
-                num_stages=num_stages,
-                tensor_parallel=tensor_parallel,
-                placement=placement,
-                devices=devices[d * group : (d + 1) * group],
-                tokenizer=tokenizer,
-                cache_dtype=cache_dtype or jnp.bfloat16,
-            )
-            self.engines.append(eng)
-            self.servers.append(
-                eng.serve(
-                    trace_path=(
-                        f"{trace_path}.r{d}" if trace_path else None
-                    ),
-                    snapshot_path=(
-                        f"{snapshot_path}.r{d}" if snapshot_path else None
-                    ),
-                    **serve_kwargs,
-                )
-            )
-        self.data_parallel = data_parallel
+            self._spawn_on_group(d)
         self._rr = 0
         # request → owning replica (weak keys: entries vanish with requests)
         self._owner: "weakref.WeakKeyDictionary[Request, PipelineServer]" = (
             weakref.WeakKeyDictionary()
         )
 
+    # ------------------------------------------------------- replica pool
+
+    def _spawn_on_group(self, d: int) -> PipelineServer:
+        """Bring a replica up on device group ``d``: a fresh engine staged
+        from the shared host params + a fresh server with the router's
+        serve kwargs. Registers it for routing/stepping/supervision."""
+        eng = PipelineEngine(
+            self._cfg,
+            self._host_params,
+            num_stages=self._num_stages,
+            tensor_parallel=self._tp,
+            placement=self._placement,
+            devices=self._groups[d],
+            tokenizer=self._tokenizer,
+            cache_dtype=self._cache_dtype,
+        )
+        srv = eng.serve(
+            trace_path=(
+                f"{self._trace_path}.r{d}" if self._trace_path else None
+            ),
+            snapshot_path=(
+                f"{self._snapshot_path}.r{d}" if self._snapshot_path else None
+            ),
+            **self._serve_kwargs,
+        )
+        self.engines.append(eng)
+        self.servers.append(srv)
+        self._by_group[d] = srv
+        self._group_of[srv] = d
+        self._failures[srv] = collections.deque()
+        self._seen_contained[srv] = srv.containment_events
+        self._set_replica_gauge(d, srv.health)
+        return srv
+
+    def _retire(self, srv: PipelineServer) -> int:
+        """Remove a replica from routing, stepping and supervision (it
+        receives no new admissions and its group is spawnable again once
+        the caller closes it). Returns the freed group index."""
+        d = self._group_of.pop(srv)
+        self._by_group.pop(d, None)
+        i = self.servers.index(srv)
+        del self.servers[i]
+        del self.engines[i]
+        self._failures.pop(srv, None)
+        self._seen_contained.pop(srv, None)
+        return d
+
+    def _set_replica_gauge(self, d: int, state: str) -> None:
+        if self._gauge_state.get(d) != state:
+            self._gauge_state[d] = state
+            set_replica_state(d, state)
+
     # ------------------------------------------------------------------ API
 
-    def _pick(self) -> PipelineServer:
-        """Least-loaded replica (queued + in-flight); round-robin ties."""
-        loads = [
-            len(s._queue) + sum(
-                r is not None and not r.done for r in s._rows
-            )
-            for s in self.servers
-        ]
-        lo = min(loads)
-        n = len(self.servers)
-        for off in range(n):
-            i = (self._rr + off) % n
-            if loads[i] == lo:
-                self._rr = (i + 1) % n
-                return self.servers[i]
-        return self.servers[0]  # unreachable
+    def _pick(self, covered: Optional[set] = None) -> PipelineServer:
+        """Health-aware least-loaded routing: only SERVING replicas receive
+        new traffic while at least one exists (a DEGRADED replica must not
+        win least-loaded ties — it is the one most likely to fail the
+        request); when none are SERVING, fall back in severity order to the
+        least-bad class. Least-loaded (queued + in-flight) within the
+        class; round-robin ties. ``covered`` restricts candidates (prefix
+        routing). Raises ``ServerClosed`` when no replica can take the
+        request."""
+        with self._lock:
+            cands = [
+                s for s in self.servers
+                if not s._closed and (covered is None or s in covered)
+            ]
+            if not cands:
+                raise ServerClosed(
+                    "no live replica can accept this request (all "
+                    "quarantined/closed"
+                    + (" or not covered by the prefix handle" if covered
+                       is not None else "") + ")"
+                )
+            serving = [
+                s for s in cands if _HEALTH_SEVERITY[s.health] == 0
+            ]
+            if not serving:
+                best = min(_HEALTH_SEVERITY[s.health] for s in cands)
+                serving = [
+                    s for s in cands if _HEALTH_SEVERITY[s.health] == best
+                ]
+            loads = {s: self._load(s) for s in serving}
+            lo = min(loads.values())
+            n = len(self.servers)
+            for off in range(n):
+                i = (self._rr + off) % n
+                s = self.servers[i]
+                if s in loads and loads[s] == lo:
+                    self._rr = (i + 1) % n
+                    return s
+            return serving[0]  # unreachable
+
+    @staticmethod
+    def _load(s: PipelineServer) -> int:
+        return len(s._queue) + sum(
+            r is not None and not r.done for r in s._rows
+        )
 
     def prefill_prefix(self, prefix_ids) -> ReplicatedPrefixHandle:
         """Prefill a shared prefix once PER REPLICA (a system prompt is
         served from every replica, so each caches its own copy — D small
-        prefills paid once, then every routed request skips it)."""
-        return ReplicatedPrefixHandle(
-            {s: s.prefill_prefix(prefix_ids) for s in self.servers}
-        )
+        prefills paid once, then every routed request skips it). The router
+        keeps a weak registry of live handles so a migrated prefix-bound
+        request can re-resolve its replica-local handle."""
+        with self._lock:
+            h = ReplicatedPrefixHandle(
+                {s: s.prefill_prefix(prefix_ids) for s in self.servers}
+            )
+            self._rhandles.add(h)
+        return h
 
     def release_prefix(self, handle: ReplicatedPrefixHandle) -> None:
         """Release the per-replica handles (paged replicas return the
@@ -160,48 +324,289 @@ class ReplicatedServer:
                 "release_prefix takes the ReplicatedPrefixHandle returned "
                 "by ReplicatedServer.prefill_prefix"
             )
-        for s, h in handle.per_server.items():
-            s.release_prefix(h)
+        with self._lock:
+            self._rhandles.discard(handle)
+            for s, h in handle.per_server.items():
+                s.release_prefix(h)
 
     def submit(self, prompt_ids, max_new_tokens: int = 128, **kw) -> Request:
-        s = self._pick()
-        pfx = kw.get("prefix")
-        if isinstance(pfx, ReplicatedPrefixHandle):
-            local = pfx.per_server.get(s)
-            if local is None:
+        with self._lock:
+            pfx = kw.get("prefix")
+            covered = None
+            if isinstance(pfx, ReplicatedPrefixHandle):
+                covered = {
+                    s for s in self.servers if s in pfx.per_server
+                }
+                if not covered:
+                    raise ValueError(
+                        "no live replica holds this prefix (its replicas "
+                        "were drained/failed over, or the handle belongs "
+                        "to a different ReplicatedServer) — re-run "
+                        "prefill_prefix"
+                    )
+            elif isinstance(pfx, PrefixHandle):
                 raise ValueError(
-                    "ReplicatedPrefixHandle belongs to a different "
-                    "ReplicatedServer (handles die with the server that "
-                    "built them — re-run prefill_prefix)"
+                    "a bare PrefixHandle is bound to one replica's devices "
+                    "— use ReplicatedServer.prefill_prefix"
                 )
-            kw["prefix"] = local
-        elif isinstance(pfx, PrefixHandle):
-            raise ValueError(
-                "a bare PrefixHandle is bound to one replica's devices — "
-                "use ReplicatedServer.prefill_prefix"
-            )
-        req = s.submit(prompt_ids, max_new_tokens, **kw)
-        self._owner[req] = s
-        return req
+            s = self._pick(covered)
+            if covered is not None:
+                kw["prefix"] = pfx.per_server[s]
+            req = s.submit(prompt_ids, max_new_tokens, **kw)
+            self._owner[req] = s
+            return req
 
-    def submit_embedding(self, prompt_embeds, max_new_tokens: int = 128, **kw) -> Request:
-        s = self._pick()
-        req = s.submit_embedding(prompt_embeds, max_new_tokens, **kw)
-        self._owner[req] = s
-        return req
+    def submit_embedding(
+        self, prompt_embeds, max_new_tokens: int = 128, **kw
+    ) -> Request:
+        with self._lock:
+            s = self._pick()
+            req = s.submit_embedding(prompt_embeds, max_new_tokens, **kw)
+            self._owner[req] = s
+            return req
 
     def embed_prompt(self, prompt_ids):
         """Privacy-entry helper (all replicas share the same weights)."""
         return self.engines[0].embed_prompt(prompt_ids)
 
+    # -------------------------------------------------------- supervision
+
     def step(self) -> bool:
-        """One step on every replica. Dispatches are async, so D chunk
-        programs land on D disjoint device groups and execute concurrently;
-        the log fetches ride the shared prefetch thread."""
+        """One supervised step on every live replica. Dispatches are async,
+        so D chunk programs land on D disjoint device groups and execute
+        concurrently; the log fetches ride the shared prefetch thread.
+
+        Supervision per replica: an injected ``replica_step`` fault (keyed
+        by group index) or a raising ``step()`` classifies the replica —
+        transient signals count against the failure window, a permanent
+        fault or an escaped exception fails it over immediately; a clean
+        step samples the replica's containment-event delta against the
+        same window. A failed-over replica's requests migrate to survivors
+        within this call."""
         progressed = False
-        for s in self.servers:
-            progressed |= s.step()
+        with self._lock:
+            for s in list(self.servers):
+                d = self._group_of.get(s)
+                if d is None:
+                    continue  # retired by an earlier failover this sweep
+                if self._fault_plan is not None:
+                    try:
+                        self._fault_plan.check("replica_step", key=d)
+                    except Exception as e:  # noqa: BLE001 — classified below
+                        progressed = True
+                        if is_transient(e):
+                            logger.warning(
+                                "replica %d: transient step fault %r", d, e
+                            )
+                            if self._note_failures(s, 1):
+                                self._fail_replica(s, e)
+                        else:
+                            self._fail_replica(s, e)
+                        continue
+                try:
+                    progressed |= s.step()
+                except Exception as e:  # noqa: BLE001 — a step that escapes
+                    # the server's own containment means the replica is gone
+                    progressed = True
+                    self._fail_replica(s, e)
+                    continue
+                delta = s.containment_events - self._seen_contained[s]
+                if delta:
+                    self._seen_contained[s] = s.containment_events
+                    if self._note_failures(s, delta):
+                        self._fail_replica(s, RuntimeError(
+                            f"replica {d} crossed the containment "
+                            f"threshold ({self.failure_threshold} events "
+                            f"within {self.failure_window_s:g}s)"
+                        ))
+                        continue
+                self._set_replica_gauge(d, s.health)
         return progressed
+
+    def _note_failures(self, s: PipelineServer, n: int) -> bool:
+        """Record ``n`` failure events against the replica's sliding window;
+        True when the threshold is crossed (the replica should fail over)."""
+        rec = self._failures[s]
+        now = time.perf_counter()
+        rec.extend([now] * n)
+        while rec and now - rec[0] > self.failure_window_s:
+            rec.popleft()
+        return len(rec) >= self.failure_threshold
+
+    def _fail_replica(self, s: PipelineServer, err: BaseException) -> None:
+        """FAILOVER: quarantine the replica (no admissions, no steps),
+        migrate every live request to survivors, close it, free its group."""
+        d = self._group_of.get(s)
+        if d is None:
+            return  # already failed over
+        logger.error(
+            "replica %d classified FAILED (%r): quarantining and migrating "
+            "its live requests", d, err,
+        )
+        REPLICA_FAILOVERS.inc()
+        self._set_replica_gauge(d, "QUARANTINED")
+        self._retire(s)
+        moved, failed = self._migrate_all(s, err)
+        try:
+            s.close()
+        except Exception:  # noqa: BLE001 — the device may be unusable; the
+            # host-side teardown already ran inside close() before any
+            # device dispatch could raise
+            logger.exception("close of failed replica %d raised", d)
+        self._set_replica_gauge(d, "OFFLINE")
+        logger.warning(
+            "replica %d failed over: %d request(s) migrated, %d failed; "
+            "%d replica(s) live", d, moved, failed, len(self.servers),
+        )
+
+    def _migrate_all(
+        self, src: PipelineServer, cause: Optional[BaseException]
+    ) -> tuple:
+        """Move every live request off ``src``: in-flight rows first (they
+        are the oldest work), then the queue. Iterated in reverse with
+        front-insertion on the target, so relative order is preserved and
+        migrated requests admit ahead of fresh traffic. Returns
+        ``(moved, failed)``."""
+        victims = [
+            r for r in src._rows if r is not None and not r.done
+        ] + [r for r in list(src._queue) if not r.done]
+        moved = failed = 0
+        for req in reversed(victims):
+            try:
+                st = src.extract(req)
+            except Exception as e:  # noqa: BLE001 — even extraction failed:
+                # the request cannot be saved, fail it typed
+                src._fail_request(req, e)
+                REQUESTS_MIGRATED.labels(outcome="failed").inc()
+                failed += 1
+                continue
+            rh = None
+            if st.prefix is not None:
+                rh = next(
+                    (h for h in self._rhandles
+                     if h.per_server.get(src) is st.prefix),
+                    None,
+                )
+            targets = sorted(
+                (t for t in self.servers
+                 if not t._closed
+                 and (st.prefix is None
+                      or (rh is not None and t in rh.per_server))),
+                key=self._load,
+            )
+            adopted = False
+            last_err: Optional[BaseException] = cause
+            for t in targets:
+                try:
+                    t.adopt(
+                        st, req,
+                        prefix=(
+                            None if st.prefix is None else rh.per_server[t]
+                        ),
+                        front=True,
+                    )
+                except (ValueError, RuntimeError) as e:
+                    last_err = e
+                    continue
+                self._owner[req] = t
+                REQUESTS_MIGRATED.labels(outcome="ok").inc()
+                adopted = True
+                moved += 1
+                break
+            if not adopted:
+                src._fail_request(req, RequestFailed(
+                    f"request {req.id} could not be migrated off its "
+                    f"failed/draining replica: "
+                    + ("no surviving replica can adopt it"
+                       if last_err is None else repr(last_err)),
+                    req,
+                ))
+                REQUESTS_MIGRATED.labels(outcome="failed").inc()
+                failed += 1
+        return moved, failed
+
+    # --------------------------------------------------------- elasticity
+
+    def drain(self, which) -> int:
+        """Elective scale-down: stop admitting to the replica, migrate
+        every live row and queued request to the other replicas (token-
+        exact — greedy continuations are identical, sampled ones resume
+        their carried rng chain), then ``close()`` it and free its device
+        group for a later ``spawn_replica()``. ``which`` is the replica's
+        device-group index (the ``:drain N`` / stats label) or the server
+        object. Returns the number of requests migrated. Refused
+        (``ValueError``) when it would leave fewer than ``min_replicas``
+        live replicas."""
+        with self._lock:
+            if isinstance(which, PipelineServer):
+                s = which if which in self._group_of else None
+            else:
+                s = self._by_group.get(int(which))
+            if s is None:
+                raise ValueError(
+                    f"no live replica {which!r} (live groups: "
+                    f"{sorted(self._by_group)})"
+                )
+            if len(self.servers) - 1 < self.min_replicas:
+                raise ValueError(
+                    f"drain refused: {len(self.servers) - 1} replica(s) "
+                    f"would remain, below min_replicas="
+                    f"{self.min_replicas}"
+                )
+            d = self._group_of[s]
+            self._set_replica_gauge(d, "DRAINING")
+            self._retire(s)  # no new admissions from here on
+            # apply every fetched-but-unapplied log first so the migrated
+            # state carries all committed tokens (elective drain runs on a
+            # healthy replica; on failure the flush is skipped — see
+            # _fail_replica — and the adopter regenerates the in-flight
+            # tokens identically)
+            try:
+                with s._mutex:
+                    s._drain(0)
+            except Exception:  # noqa: BLE001 — migrate from last applied
+                logger.exception(
+                    "drain: log flush on replica %d failed; migrating from "
+                    "the last applied state", d,
+                )
+            moved, failed = self._migrate_all(s, None)
+            try:
+                s.close()
+            except Exception:  # noqa: BLE001
+                logger.exception("drain: close of replica %d raised", d)
+            REPLICA_DRAINS.inc()
+            self._set_replica_gauge(d, "OFFLINE")
+            logger.info(
+                "replica %d drained: %d migrated, %d failed; %d replica(s) "
+                "live", d, moved, failed, len(self.servers),
+            )
+            return moved
+
+    def spawn_replica(self) -> PipelineServer:
+        """Elective scale-up: bring a fresh replica up on the lowest freed
+        device group (weights re-staged from the host arrays the router
+        kept; compiled programs come from the process-wide jit cache, so a
+        respawn on an identical group shape recompiles nothing). Raises
+        ``ValueError`` when every group already runs a replica."""
+        with self._lock:
+            free = sorted(
+                d for d in range(len(self._groups)) if d not in self._by_group
+            )
+            if not free:
+                raise ValueError(
+                    "no freed device group to spawn on (every group runs a "
+                    "replica; drain one first)"
+                )
+            d = free[0]
+            srv = self._spawn_on_group(d)
+            REPLICA_SPAWNS.inc()
+            logger.info(
+                "replica spawned on group %d; %d replica(s) live",
+                d, len(self.servers),
+            )
+            return srv
+
+    # ------------------------------------------------------------ serving
 
     def run_until_idle(self) -> None:
         while any(
@@ -212,34 +617,46 @@ class ReplicatedServer:
     def cancel(self, req: Request) -> bool:
         """Routed to the owning replica (PipelineServer.cancel additionally
         verifies row ownership, so a stray broadcast can never kill another
-        replica's row)."""
-        s = self._owner.get(req)
-        return s.cancel(req) if s is not None else False
+        replica's row). Under the router lock so a cancel can never
+        interleave with the request migrating between replicas."""
+        with self._lock:
+            s = self._owner.get(req)
+            return s.cancel(req) if s is not None else False
 
     def stream(self, req: Request) -> Iterator[int]:
         """Stream one request's tokens, pumping EVERY replica (other
         replicas' requests keep decoding while this one streams). Token
-        reads snapshot under the OWNING replica's mutex — the same
-        stop-sequence truncation guarantee as PipelineServer.stream."""
-        owner = self._owner.get(req)
+        reads snapshot under the OWNING replica's mutex — re-resolved each
+        iteration, because a failover/drain may migrate the request to
+        another replica mid-stream (the token list is the same object; the
+        stream never notices beyond a brief re-prefill gap). A request
+        that FAILED raises the typed ``RequestFailed`` after its partial
+        tokens, exactly like ``PipelineServer.stream``."""
         idx = 0
         while True:
+            owner = self._owner.get(req)
             if owner is not None:
                 with owner._mutex:
                     batch = req.tokens[idx:]
                     done = req.done
+                    error = req.error
             else:
                 batch = req.tokens[idx:]
                 done = req.done
+                error = req.error
             for t in batch:
                 yield t
             idx += len(batch)
             if done:
+                if error is not None:
+                    raise RequestFailed(
+                        f"request {req.id} failed: {error}", req
+                    ) from error
                 return
             self.step()
 
     def snapshot(self) -> list:
-        """Checkpoint every replica's live serving state (see
+        """Checkpoint every live replica's serving state (see
         ``PipelineServer.snapshot``): a list of per-replica snapshots, in
         replica order."""
         return [s.snapshot() for s in self.servers]
@@ -260,7 +677,26 @@ class ReplicatedServer:
             PipelineServer.restore(eng, snap)
             for eng, snap in zip(rsrv.engines, snaps)
         ]
+        # swap the restored servers into the supervision tables; the fresh
+        # (empty) servers they replace are closed so they stop voting on
+        # the process health gauge
+        old = rsrv.servers
         rsrv.servers = restored
+        rsrv._by_group = {}
+        rsrv._group_of = {}
+        rsrv._failures = {}
+        rsrv._seen_contained = {}
+        for d, s in enumerate(restored):
+            rsrv._by_group[d] = s
+            rsrv._group_of[s] = d
+            rsrv._failures[s] = collections.deque()
+            rsrv._seen_contained[s] = s.containment_events
+            rsrv._set_replica_gauge(d, s.health)
+        for s in old:
+            try:
+                s.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                logger.exception("restore_into: closing a template server")
         rsrv._owner = weakref.WeakKeyDictionary()
         for s in restored:
             for r in list(s._rows) + list(s._queue):
@@ -270,7 +706,7 @@ class ReplicatedServer:
 
     @property
     def counters(self):
-        """Aggregated counters across replicas."""
+        """Aggregated counters across live replicas."""
         from .server import Counters
 
         agg = Counters()
@@ -281,12 +717,16 @@ class ReplicatedServer:
 
     @property
     def health(self) -> str:
-        """Router health = the WORST replica state (a degraded replica
-        degrades the endpoint: the router may still route onto it). Feeds
-        the same ``/healthz`` provider slot as a single server's
+        """Router health = the WORST live replica state (a degraded replica
+        degrades the endpoint; quarantined/closed replicas no longer vote —
+        surviving a replica loss is exactly what keeps the endpoint
+        SERVING). With no live replica at all the endpoint is DRAINING.
+        Feeds the same ``/healthz`` provider slot as a single server's
         ``health``."""
-        from .server import _HEALTH_SEVERITY
+        from .server import DRAINING
 
+        if not self.servers:
+            return DRAINING
         return max(
             (s.health for s in self.servers),
             key=_HEALTH_SEVERITY.__getitem__,
@@ -295,24 +735,60 @@ class ReplicatedServer:
     def close(self) -> None:
         """Shut every replica down (``PipelineServer.close``: submits
         rejected, queued/in-flight requests failed with ``ServerClosed``,
-        traces flushed). Idempotent."""
-        for s in self.servers:
-            s.close()
+        traces flushed). Idempotent. EVERY replica is closed even when one
+        raises — the per-replica errors are collected and re-raised as one
+        aggregated error after the loop, so a single wedged replica can
+        never block daemon shutdown (and leave the others' trace files
+        unflushed)."""
+        with self._lock:
+            errs = []
+            for s in list(self.servers):
+                d = self._group_of.get(s)
+                try:
+                    s.close()
+                except Exception as e:  # noqa: BLE001 — keep closing
+                    errs.append((d, e))
+                    logger.exception("close: replica %s raised", d)
+                else:
+                    if d is not None:
+                        self._set_replica_gauge(d, s.health)
+            if errs:
+                detail = "; ".join(f"replica {d}: {e!r}" for d, e in errs)
+                raise RuntimeError(
+                    f"close failed on {len(errs)} of "
+                    f"{len(self.servers)} replica(s) — all others were "
+                    f"closed: {detail}"
+                ) from errs[0][1]
 
     def stats(self) -> dict:
         """Router-level view for ``/statz``: the aggregate counter snapshot
-        plus per-replica counters and load (queued + in-flight), so an
-        operator can see a hot or stuck replica instead of only the sum."""
-        return {
-            "counters": self.counters.snapshot(),
-            "replicas": [
-                {
+        plus per-replica counters, load (queued + in-flight), HEALTH and —
+        on paged replicas — KV-block occupancy, so an operator can see
+        WHICH replica is hot, degraded or out of blocks instead of only
+        the worst-of aggregate. ``offline_groups`` lists freed device
+        groups a ``spawn_replica()`` would reuse."""
+        with self._lock:
+            replicas = []
+            for d in sorted(self._by_group):
+                s = self._by_group[d]
+                entry = {
+                    "replica": d,
+                    "health": s.health,
                     "counters": s.counters.snapshot(),
                     "queued": len(s._queue),
                     "in_flight": sum(
                         r is not None and not r.done for r in s._rows
                     ),
                 }
-                for s in self.servers
-            ],
-        }
+                if s.paged:
+                    entry["kv_blocks_in_use"] = s._alloc.in_use
+                    entry["kv_blocks_total"] = s._alloc.capacity_blocks
+                replicas.append(entry)
+            return {
+                "counters": self.counters.snapshot(),
+                "replicas": replicas,
+                "offline_groups": sorted(
+                    d for d in range(len(self._groups))
+                    if d not in self._by_group
+                ),
+            }

@@ -626,6 +626,13 @@ class Request:
         "spec_k",  # per-request adaptive draft-width controller (spec mode)
         "deadline_at",  # absolute (perf_counter) deadline; None = none
         "error",  # why the request FAILED (deadline/containment/shutdown)
+        "baked",  # leading entries of ``tokens`` already folded into
+        #           ``prompt``/``embeds`` by a live migration (``adopt``
+        #           re-admits the request with generated-so-far as prompt
+        #           tail; consumers still read the FULL generation from
+        #           ``tokens``)
+        "carried_rng",  # [2] uint32 sampling chain a migration carried in;
+        #           consumed (installed on device) at the next admission
         "__weakref__",  # the dp router tracks request→replica ownership
     )
 
@@ -662,6 +669,8 @@ class Request:
         self.row: Optional[int] = None
         self.spec_k = None  # set by a speculative server at submit
         self.error: Optional[BaseException] = None
+        self.baked = 0
+        self.carried_rng: Optional[np.ndarray] = None
         self.submitted_at = time.perf_counter()
         self.deadline_at = (
             None if deadline_s is None else self.submitted_at + deadline_s
@@ -670,6 +679,65 @@ class Request:
         self.finished_at: Optional[float] = None
         self.first_token_at: Optional[float] = None
         self.last_token_at: Optional[float] = None
+
+
+@dataclasses.dataclass
+class RequestState:
+    """PORTABLE per-request state, host-side only: everything another
+    replica needs to continue a live request exactly where this one left it
+    (``PipelineServer.extract`` builds it, ``PipelineServer.adopt``
+    re-admits it). Deliberately contains NO device arrays and requires NO
+    device read to build — extraction works on a replica whose devices are
+    already gone, which is the whole point of replica failover.
+
+    ``prompt`` is the RESUMED prompt: the original ids with every token
+    generated so far appended, so the target replica's ordinary (chunked-)
+    prefill recomputes the row's KV from scratch — token-identical to the
+    decode-accumulated KV it replaces. For the embeddings (privacy) entry,
+    ``embeds`` carries the original hidden states and ``tail`` the
+    generated ids the adopter embeds locally (every replica shares the
+    weights, so the lookup is the same math the decode step did).
+
+    ``rng`` is the carried sampling chain — ``len(req.tokens)`` splits of
+    ``key(seed)``, recomputed HOST-SIDE (threefry is backend-deterministic)
+    rather than fetched from the possibly-dead source device; ``None`` for
+    greedy rows and never-admitted queued requests."""
+
+    prompt: np.ndarray                 # resumed ids ([0] for embeds entry)
+    embeds: Optional[np.ndarray]       # original hidden states, or None
+    tail: np.ndarray                   # generated ids not yet embedded
+    remaining: int                     # new-token budget still unspent
+    rng: Optional[np.ndarray]          # [2] uint32 carried chain, or None
+    prefix: Optional["PrefixHandle"]   # the SOURCE replica's local handle
+    #   (the dp router re-resolves it to the target's local handle)
+
+
+@jax.jit
+def _advance_chain(kd, draws):
+    """``draws`` splits of a raw [2] uint32 key — the per-row chain walk the
+    serve programs perform once per committed token. One compile (the bound
+    is dynamic); runs on the default backend, and threefry gives identical
+    bits on every backend, so the host-recomputed chain matches what the
+    source replica's device held."""
+
+    def body(_, k):
+        nk, _sub = jax.random.split(jax.random.wrap_key_data(k))
+        return jax.random.key_data(nk)
+
+    return jax.lax.fori_loop(0, draws, body, kd)
+
+
+def rng_chain_at(seed: int, draws: int) -> np.ndarray:
+    """Raw [2] uint32 key data of a request's sampling chain after ``draws``
+    committed tokens: ``draws`` splits of ``key(seed)``. This is the value
+    ``ServeState.rng`` holds for the row at that point (admission performs
+    split #1 when it samples the first token; every later commit splits
+    once), so a migrated row seeded with it resumes the exact draw sequence
+    of an unfaulted run."""
+    kd = jax.random.key_data(jax.random.key(int(seed)))
+    return np.asarray(
+        _advance_chain(kd, jnp.asarray(int(draws), jnp.int32)), np.uint32
+    )
 
 
 class PrefixHandle:
@@ -851,6 +919,10 @@ class PipelineServer:
         self._health = SERVING
         self._closed = False
         self._step_contained = False  # a containment event this step
+        # monotonic containment tally — the dp router's failure-detection
+        # signal (it samples the delta per step and quarantines a replica
+        # whose events cross the threshold inside the window)
+        self.containment_events = 0
         self._snapshot_every_s: Optional[float] = None
         self._snapshot_path: Optional[str] = None
         self._last_snapshot_at = time.perf_counter()
@@ -1002,51 +1074,7 @@ class PipelineServer:
                 self._bucket(prompt.shape[0]), max_new_tokens, chunkable=True
             )
         else:
-            if prompt.shape[0] < 1:
-                raise ValueError(
-                    "prefix requests need a non-empty suffix (the first "
-                    "token is sampled from the suffix's last position)"
-                )
-            # prefix admissions are always one-shot (suffixes are short by
-            # design); cache rows = padded prefix + suffix bucket + decode
-            bucket = self._bucket(prompt.shape[0])
-            if prefix.spx + bucket + max_new_tokens > self.capacity:
-                raise ValueError(
-                    f"prefix rows ({prefix.spx}) + suffix bucket ({bucket}) "
-                    f"+ max_new ({max_new_tokens}) exceeds server capacity "
-                    f"({self.capacity})"
-                )
-            total_pos = prefix.n + bucket + max_new_tokens
-            if total_pos > self.cfg.max_position_embeddings:
-                raise ValueError(
-                    f"requested {total_pos} positions > "
-                    f"max_position_embeddings "
-                    f"({self.cfg.max_position_embeddings})"
-                )
-        if self.paged and prefix is not None:
-            # ownership first: a foreign (or dense-built) handle's block
-            # ids don't index THIS pool, so mapping them would corrupt
-            # live rows. Then staleness: a released handle's blocks are
-            # gone even on its own server.
-            if not prefix.owned_by(self) and prefix.blocks is not None:
-                raise ValueError(
-                    "prefix handle belongs to a different server — its "
-                    "block ids index that server's KV pool, so mapping "
-                    "them here would corrupt live rows; prefill_prefix "
-                    "on THIS server"
-                )
-            if prefix.blocks is None:
-                if prefix.owner is None:
-                    raise ValueError(
-                        "prefix handle was prefilled on a DENSE server — "
-                        "it carries no KV blocks; prefill_prefix on this "
-                        "paged server instead"
-                    )
-                raise ValueError(
-                    "prefix handle was released (release_prefix) — its "
-                    "shared blocks are gone; prefill_prefix the prefix "
-                    "again before submitting suffix requests against it"
-                )
+            self._validate_prefix_request(prefix, prompt, max_new_tokens)
         stop = self._validate_stop(stop)
         with self._mutex:
             # admission control first: a closed/full server must reject
@@ -1192,6 +1220,13 @@ class PipelineServer:
                     "tokens": list(r.tokens),
                     "done": r.done,
                     "row": r.row,
+                    # migration bookkeeping: tokens already folded into the
+                    # prompt, and a not-yet-consumed carried sampling chain
+                    "baked": r.baked,
+                    "carried_rng": (
+                        None if r.carried_rng is None
+                        else [int(x) for x in r.carried_rng]
+                    ),
                     # deadlines are stored as TIME REMAINING: perf_counter
                     # epochs don't survive a process, the budget does
                     "deadline_left": (
@@ -1348,6 +1383,10 @@ class PipelineServer:
             r.tokens = list(d["tokens"])
             r.done = d["done"]
             r.row = d["row"]
+            # .get(): format-1/2 snapshots predate migration bookkeeping
+            r.baked = int(d.get("baked", 0) or 0)
+            cr = d.get("carried_rng")
+            r.carried_rng = None if cr is None else np.asarray(cr, np.uint32)
             if d.get("deadline_left") is not None:
                 # re-arm from the remaining budget at snapshot time — the
                 # downtime between crash and restore does not count against
@@ -1383,8 +1422,11 @@ class PipelineServer:
             if r is None:
                 continue
             spx = d.get("spx", 0)
+            # tokens[:baked] ride inside the (resumed) prompt, so only the
+            # post-migration run counts toward the mirror beyond prompt_len
             pfx_n = (
-                int(snap["mirror_len"][r.row]) - len(r.tokens) - r.prompt_len
+                int(snap["mirror_len"][r.row]) - (len(r.tokens) - r.baked)
+                - r.prompt_len
             )
             srv._mirror_cachedelta[r.row] = (
                 spx + srv._bucket(r.prompt_len) - (pfx_n + r.prompt_len)
@@ -1835,6 +1877,58 @@ class PipelineServer:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         return deadline_s
 
+    def _validate_prefix_request(
+        self, prefix: PrefixHandle, prompt: np.ndarray, max_new: int
+    ) -> None:
+        """Budget + handle validation for a prefix-bound suffix request —
+        one definition for ``submit`` and ``adopt`` (a migrated suffix
+        request re-validates against the TARGET replica's handle)."""
+        if prompt.shape[0] < 1:
+            raise ValueError(
+                "prefix requests need a non-empty suffix (the first "
+                "token is sampled from the suffix's last position)"
+            )
+        # prefix admissions are always one-shot (suffixes are short by
+        # design); cache rows = padded prefix + suffix bucket + decode
+        bucket = self._bucket(prompt.shape[0])
+        if prefix.spx + bucket + max_new > self.capacity:
+            raise ValueError(
+                f"prefix rows ({prefix.spx}) + suffix bucket ({bucket}) "
+                f"+ max_new ({max_new}) exceeds server capacity "
+                f"({self.capacity})"
+            )
+        total_pos = prefix.n + bucket + max_new
+        if total_pos > self.cfg.max_position_embeddings:
+            raise ValueError(
+                f"requested {total_pos} positions > "
+                f"max_position_embeddings "
+                f"({self.cfg.max_position_embeddings})"
+            )
+        if self.paged:
+            # ownership first: a foreign (or dense-built) handle's block
+            # ids don't index THIS pool, so mapping them would corrupt
+            # live rows. Then staleness: a released handle's blocks are
+            # gone even on its own server.
+            if not prefix.owned_by(self) and prefix.blocks is not None:
+                raise ValueError(
+                    "prefix handle belongs to a different server — its "
+                    "block ids index that server's KV pool, so mapping "
+                    "them here would corrupt live rows; prefill_prefix "
+                    "on THIS server"
+                )
+            if prefix.blocks is None:
+                if prefix.owner is None:
+                    raise ValueError(
+                        "prefix handle was prefilled on a DENSE server — "
+                        "it carries no KV blocks; prefill_prefix on this "
+                        "paged server instead"
+                    )
+                raise ValueError(
+                    "prefix handle was released (release_prefix) — its "
+                    "shared blocks are gone; prefill_prefix the prefix "
+                    "again before submitting suffix requests against it"
+                )
+
     def _check_admission(self) -> None:
         """Backpressure gate on every submit path (called under the mutex):
         explicit typed rejection beats an unbounded queue in front of a
@@ -1971,6 +2065,206 @@ class PipelineServer:
                 self._alloc.free(blocks)
                 _update_load_gauges()
 
+    # ------------------------------------ live migration (dp supervision)
+
+    def extract(self, req: Request) -> RequestState:
+        """Pull a LIVE request off this server as portable host-side state
+        (``RequestState``) WITHOUT failing it: the request leaves the queue
+        or its slot row (device cancel is best-effort — a dead replica's
+        dispatch failure is logged and ignored; the row dies with the
+        replica), its blocks free, and the caller re-admits it elsewhere
+        via ``adopt``. The request object itself is untouched beyond
+        ``row=None``, so live ``stream()``/``result()`` consumers never
+        notice.
+
+        Needs NO device read: the resumed prompt is the host-applied token
+        mirror, and the sampling chain is recomputed from ``(seed, tokens
+        applied)`` — which is also the only state CONSISTENT with what
+        consumers saw (a dispatched-but-unapplied chunk's tokens were never
+        yielded; the adopter simply regenerates them, token-identically).
+
+        On a SPECULATIVE sampled server the device chain advances per
+        verify step, not per token, so the recomputed chain is a fresh
+        deterministic continuation rather than the unfaulted run's exact
+        draws (greedy spec rows stay token-identical either way)."""
+        with self._mutex:
+            if req.done:
+                raise ValueError(
+                    f"request {req.id} is finished; nothing to extract"
+                )
+            if req.row is None:
+                try:
+                    self._queue.remove(req)
+                except ValueError:
+                    raise ValueError(
+                        f"request {req.id} is not held by this server"
+                    ) from None
+            else:
+                if self._rows[req.row] is not req:
+                    raise ValueError(
+                        f"request {req.id} is not held by this server"
+                    )
+                if req.row in self._admitting_rows:
+                    raise RuntimeError(
+                        f"request {req.id} is mid-chunked-admission; "
+                        "extract between steps"
+                    )
+                try:
+                    self._cancel_rows([req.row])
+                except Exception:  # noqa: BLE001 — a failed replica's
+                    # device may be gone; the host-side extraction is
+                    # complete without it
+                    logger.exception(
+                        "extract: device cancel failed for row %d "
+                        "(continuing; the row dies with the replica)",
+                        req.row,
+                    )
+                self._rows[req.row] = None
+                self._release_row_blocks(req.row)
+                self._mirror_len[req.row] = 0
+                self._mirror_budget[req.row] = 0
+                self._mirror_cachedelta[req.row] = 0
+                req.row = None
+            tail = np.asarray(req.tokens[req.baked:], np.int32)
+            remaining = int(req.max_new) - int(tail.shape[0])
+            if req.embeds is not None:
+                prompt = np.zeros((0,), np.int32)
+                embeds = np.asarray(req.embeds)
+            else:
+                prompt = np.asarray(req.prompt, np.int32)
+                if tail.size:
+                    prompt = np.concatenate([prompt, tail])
+                embeds = None
+            rng = None
+            if req.temperature > 0 and req.tokens:
+                # the chain state consistent with the tokens consumers got:
+                # one split per committed token, from key(seed)
+                rng = rng_chain_at(req.seed, len(req.tokens))
+            _update_load_gauges()
+        logger.info(
+            "extract id=%d tokens=%d remaining=%d rng=%s",
+            req.id, len(req.tokens), remaining, rng is not None,
+        )
+        return RequestState(
+            prompt=prompt, embeds=embeds, tail=tail,
+            remaining=remaining, rng=rng, prefix=req.prefix,
+        )
+
+    def adopt(
+        self,
+        state: RequestState,
+        req: Request,
+        *,
+        prefix: Optional[PrefixHandle] = None,
+        front: bool = True,
+    ) -> None:
+        """Re-admit an ``extract``ed request on THIS server, preserving the
+        caller's ``Request`` object identity: the resumed prompt (original
+        + generated-so-far) goes back through the ordinary (chunked-)
+        prefill admission path, new tokens keep appending to the same
+        ``tokens`` list, and a carried sampling chain is installed at
+        admission so sampled continuation resumes the unfaulted draw
+        sequence. ``prefix`` is the TARGET-local handle a prefix-bound
+        request re-resolves to (the dp router maps it via the
+        ``ReplicatedPrefixHandle.per_server`` table).
+
+        Raises ``ServerClosed`` on a closed server and ``ValueError`` when
+        the resumed request cannot fit here (capacity, paged never-fits,
+        missing tokenizer for stop strings) — the router treats either as
+        "try another survivor". Validation runs BEFORE any mutation, so a
+        refused adopt leaves the request re-adoptable elsewhere.
+        ``front=True`` (default) queues it ahead of fresh submissions —
+        migrated requests are the oldest work in the system. Deliberately
+        NOT gated on ``max_queue``: migration moves existing load, it does
+        not add any."""
+        with self._mutex:
+            if self._closed:
+                _M_REJECTED.labels(reason="closed").inc()
+                raise ServerClosed("server is closed; adopt rejected")
+            if req.done:
+                raise ValueError(f"request {req.id} is already finished")
+            if req.stop and self.engine.tokenizer is None:
+                raise ValueError(
+                    "request carries stop strings but this replica's "
+                    "engine has no tokenizer"
+                )
+            remaining = int(state.remaining)
+            if remaining < 1:
+                # already at budget when extracted: complete, don't re-admit
+                req.done = True
+                req.finished_at = time.perf_counter()
+                self.counters.inc("requests_completed")
+                return
+            if state.embeds is not None:
+                h = np.asarray(state.embeds, self._act_dtype)
+                if state.tail.size:
+                    # embed the generated run locally (shared weights: the
+                    # same lookup the source's decode steps performed)
+                    th = np.asarray(
+                        self.engine.embed_prompt(state.tail)[0],
+                        self._act_dtype,
+                    )
+                    h = np.concatenate([h, th], axis=0)
+                self._validate_budget(
+                    self._bucket(h.shape[0]), remaining, chunkable=False
+                )
+                if self.paged:
+                    self._check_never_fits(self._bucket(h.shape[0]), remaining)
+                req.embeds = h
+                req.prompt = np.zeros((0,), np.int32)
+                req.prefix = None
+            elif prefix is not None:
+                prompt = np.asarray(state.prompt, np.int32)
+                self._validate_prefix_request(prefix, prompt, remaining)
+                if self.paged:
+                    self._check_never_fits(
+                        self._bucket(prompt.shape[0]), remaining, prefix.spx,
+                    )
+                req.prompt = prompt
+                req.embeds = None
+                req.prefix = prefix
+            else:
+                prompt = np.asarray(state.prompt, np.int32)
+                bucket = self._bucket(prompt.shape[0])
+                self._validate_budget(bucket, remaining, chunkable=True)
+                if self.paged:
+                    self._check_never_fits(
+                        bucket, remaining, 0, self._chunked(bucket)
+                    )
+                req.prompt = prompt
+                req.embeds = None
+                req.prefix = None
+            req.prompt_len = int(
+                req.prompt.shape[0] if req.embeds is None
+                else req.embeds.shape[0]
+            )
+            req.max_new = remaining
+            req.baked = len(req.tokens)
+            req.carried_rng = (
+                None if state.rng is None
+                else np.asarray(state.rng, np.uint32)
+            )
+            req.row = None
+            if self.speculate:
+                from .spec import AdaptiveK
+
+                req.spec_k = AdaptiveK(self.speculate)
+            else:
+                req.spec_k = None
+            if req.temperature > 0:
+                self._sampling = True
+            if req.top_k > 0 or req.top_p < 1.0:
+                self._filtering = True
+            if front:
+                self._queue.appendleft(req)
+            else:
+                self._queue.append(req)
+            _update_load_gauges()
+        logger.info(
+            "adopt id=%d resumed_prompt=%d remaining=%d carried_rng=%s",
+            req.id, req.prompt_len, remaining, req.carried_rng is not None,
+        )
+
     # ------------------------------------------------- resilience internals
 
     def _fault_check(self, site: str, key=None) -> None:
@@ -2030,6 +2324,7 @@ class PipelineServer:
         requests, drop to DEGRADED. Every other slot keeps decoding and the
         freed rows re-admit from the queue on the next step."""
         self._step_contained = True
+        self.containment_events += 1
         self._set_health(DEGRADED)
         _M_CONTAINED.labels(site=site).inc()
         victims = [
@@ -2060,6 +2355,7 @@ class PipelineServer:
         done and simply re-admit other requests later; the host mirrors the
         batch had already claimed are rolled back."""
         self._step_contained = True
+        self.containment_events += 1
         self._set_health(DEGRADED)
         _M_CONTAINED.labels(site="admit_dispatch").inc()
         for r in batch:
@@ -2366,6 +2662,10 @@ class PipelineServer:
             temps = np.zeros((Bs,), np.float32)
             topks = np.zeros((Bs,), np.int32)
             topps = np.ones((Bs,), np.float32)
+            # migrated rows resume their sampling chain: the carried key
+            # rides the admission dispatch as a per-row override
+            rngs = np.zeros((Bs, 2), np.uint32)
+            rng_mask = np.zeros((Bs,), bool)
             for i, r in enumerate(batch):
                 if is_emb:
                     embeds[i, : r.prompt_len] = r.embeds
@@ -2378,6 +2678,10 @@ class PipelineServer:
                 temps[i] = max(r.temperature, 0.0)
                 topks[i] = r.top_k
                 topps[i] = r.top_p
+                if r.carried_rng is not None:
+                    rngs[i] = r.carried_rng
+                    rng_mask[i] = True
+                    r.carried_rng = None  # consumed by this admission
                 r.row = slot * Bs + i
                 r.started_at = time.perf_counter()
                 _M_QUEUE_WAIT.observe(r.started_at - r.submitted_at)
@@ -2407,20 +2711,22 @@ class PipelineServer:
                 slot=slot, bucket=bucket, batch=batch, is_emb=is_emb,
                 pfx=pfx, prompts=prompts, embeds=embeds, plen=plen,
                 row_valid=row_valid, max_new=max_new, seeds=seeds,
-                temps=temps, topks=topks, topps=topps,
+                temps=temps, topks=topks, topps=topps, rngs=rngs,
+                rng_mask=rng_mask,
             ):
                 self._fault_check("admit_dispatch")
+                carried = bool(rng_mask.any())
                 if not is_emb and pfx is None and self._chunked(bucket):
                     self._admit_chunked(
                         slot, prompts, plen, row_valid, max_new, seeds,
-                        temps, topks, topps,
+                        temps, topks, topps, rngs, rng_mask,
                     )
                     return
                 record_shape_key(
                     "serve_admit",
                     (self.num_stages, Bs, self.capacity, bucket, is_emb,
                      None if pfx is None else pfx.spx, self._filtering,
-                     self.tp, self.kv_block_size),
+                     self.tp, self.kv_block_size, carried),
                 )
                 self.state, tok0 = serve_ops.serve_admit(
                     self.cfg,
@@ -2447,6 +2753,10 @@ class PipelineServer:
                     prefix_kv=None if pfx is None else pfx.kv,
                     prefix_len=(
                         None if pfx is None else jnp.asarray(pfx.n, jnp.int32)
+                    ),
+                    key_override=(
+                        (jnp.asarray(rngs), jnp.asarray(rng_mask))
+                        if carried else None
                     ),
                     tp=self.tp,
                     block_size=self.kv_block_size or 0,
@@ -2490,7 +2800,7 @@ class PipelineServer:
 
     def _admit_chunked(
         self, slot, prompts, plen, row_valid, max_new, seeds, temps,
-        topks, topps,
+        topks, topps, rngs=None, rng_mask=None,
     ) -> None:
         """Chunked admission: bounded prefill chunks with one decode cycle
         interleaved after each, so in-flight slots keep producing tokens
@@ -2565,9 +2875,10 @@ class PipelineServer:
                 self.counters.inc("chunks")
                 self._drain(self.pipeline_depth)
         last_tok = prompts[np.arange(Bs), np.maximum(plen - 1, 0)]
+        carried = rng_mask is not None and bool(rng_mask.any())
         record_shape_key(
             "serve_admit_finish",
-            (self.num_stages, Bs, self.capacity, self.tp),
+            (self.num_stages, Bs, self.capacity, self.tp, carried),
         )
         self.state = serve_ops.serve_admit_finish(
             self.cfg,
@@ -2585,6 +2896,10 @@ class PipelineServer:
             jnp.asarray(topps),
             self.num_stages,
             tp=self.tp,
+            key_override=(
+                (jnp.asarray(rngs), jnp.asarray(rng_mask))
+                if carried else None
+            ),
         )
         self._admitting_rows.difference_update(range(row0, row0 + Bs))
 
@@ -2618,9 +2933,13 @@ class PipelineServer:
             cache_delta = np.zeros((Bs,), np.int32)
             for row, req in live:
                 i = row - slot * Bs
+                # tokens[:baked] are already folded into a migrated
+                # request's prompt — concatenating the full list would
+                # double-count them in the lookup window
+                tail = req.tokens[req.baked:]
                 ids = np.concatenate(
-                    [np.asarray(req.prompt, np.int64), req.tokens]
-                ) if req.tokens else np.asarray(req.prompt, np.int64)
+                    [np.asarray(req.prompt, np.int64), tail]
+                ) if tail else np.asarray(req.prompt, np.int64)
                 d = ngram_draft(ids, req.spec_k.k, self.spec_ngram)
                 draft[i, : d.shape[0]] = d
                 draft_len[i] = d.shape[0]

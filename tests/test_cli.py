@@ -552,3 +552,39 @@ def test_serve_command_data_parallel(shards, capsys, monkeypatch):
     assert len([l for l in captured.out.splitlines() if l.strip()]) == 2
     assert '"requests_completed": 2' in captured.err
     assert "2 replicas" in captured.err
+
+
+def test_serve_command_dp_drain_spawn(shards, capsys, monkeypatch):
+    """dp daemon elasticity control lines: ':drain N' migrates replica N's
+    work and closes it (refusing an unknown group typed), ':spawn' brings
+    a replica back on the freed group — prompts keep serving throughout."""
+    from llm_sharding_tpu.runtime import engine as engine_mod
+
+    monkeypatch.setattr(
+        engine_mod.PipelineEngine,
+        "_require_tokenizer",
+        lambda self: IdTokenizer(),
+    )
+    monkeypatch.setattr(
+        "sys.stdin",
+        io.StringIO(
+            "hi there\n:drain 1\nsecond one\n:spawn\nthird line\n"
+            ":drain 9\n:bogus\n"
+        ),
+    )
+    rc = cli.main(
+        [
+            "serve", shards, "--max-new", "4", "--stages", "2",
+            "--data-parallel", "2", "--min-replicas", "1",
+            "--capacity", "64", "--dtype", "f32",
+        ]
+    )
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert len([l for l in captured.out.splitlines() if l.strip()]) == 3
+    err = captured.err
+    assert "replica 1 drained" in err
+    assert "replica spawned on group 1" in err
+    assert "drain failed: no live replica 9" in err
+    assert "unknown control line ':bogus'" in err
+    assert '"requests_completed": 3' in err

@@ -494,3 +494,22 @@ def test_health_state_machine_and_gauge(setup):
     assert ok.error is None and srv.health == "SERVING"
     srv.close()
     assert srv.health == "DRAINING"
+
+
+def test_replica_step_site_keyed_per_group():
+    """The replica-level crash site (``replica_step``, keyed by the dp
+    router with the replica's device-group index): a plan armed for one
+    group must count and fire per key — the other replicas' checks advance
+    their own counters and never trip it."""
+    plan = FaultPlan.permanent("replica_step", key=1, start=2)
+    for _ in range(5):
+        plan.check("replica_step", key=0)  # another replica: never fires
+    plan.check("replica_step", key=1)  # pass 0
+    plan.check("replica_step", key=1)  # pass 1
+    with pytest.raises(PermanentFault):
+        plan.check("replica_step", key=1)  # pass 2 = start -> fires
+    assert plan.stats()["total_fires"] == 1
+    # unknown sites still refuse at construction (typo'd chaos plans fail
+    # loudly, not vacuously)
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultSpec("replica_crash")

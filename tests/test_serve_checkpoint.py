@@ -296,3 +296,165 @@ def test_restore_runs_engine_serve_validation(setup):
     )
     with pytest.raises(NotImplementedError, match="ReplicatedServer"):
         PipelineServer.restore(eng_dp, snap)
+
+
+# ------------------------------------------------ portable request state
+# (PipelineServer.extract / adopt — the migration primitive the dp
+# supervision layer in runtime/replicated.py builds failover and drain on;
+# exercised here server-to-server without a router)
+
+
+@pytest.fixture(scope="module")
+def two_servers(setup):
+    """Two INDEPENDENT single-engine servers over disjoint device groups —
+    the minimal migration topology."""
+    params, _ = setup
+    ea = PipelineEngine(
+        CFG, params, num_stages=2, devices=jax.devices()[:2],
+        cache_dtype=jnp.float32,
+    )
+    eb = PipelineEngine(
+        CFG, params, num_stages=2, devices=jax.devices()[2:4],
+        cache_dtype=jnp.float32,
+    )
+    return params, ea.serve(capacity=64), eb.serve(capacity=64)
+
+
+def test_extract_adopt_mid_decode_token_exact(two_servers):
+    """Greedy AND seeded-sampled requests extracted mid-decode from server
+    A and adopted on server B finish token-identically to the
+    uninterrupted oracle, through the SAME Request objects (the consumer's
+    token list keeps growing in place)."""
+    params, sa, sb = two_servers
+    rng = np.random.default_rng(71)
+    pa = rng.integers(1, CFG.vocab_size, 5).astype(np.int32)
+    pb = rng.integers(1, CFG.vocab_size, 4).astype(np.int32)
+    ra = sa.submit(pa, 14)
+    rb = sa.submit(pb, 14, temperature=0.9, seed=21)
+    for _ in range(5):
+        sa.step()
+    assert ra.tokens and rb.tokens, "requests must be mid-decode"
+    toks_a, toks_b = ra.tokens, rb.tokens  # the live consumer views
+    for r in (ra, rb):
+        st = sa.extract(r)
+        assert st.remaining == 14 - len(r.tokens)
+        sb.adopt(st, r)
+    # rng carry: greedy rows carry none, sampled rows carry the chain at
+    # exactly len(tokens) splits
+    assert ra.carried_rng is None and rb.carried_rng is not None
+    assert sb.result(ra) == oracle(params, pa, 14)
+    assert sb.result(rb) == oracle(params, pb, 14, temperature=0.9, seed=21)
+    assert ra.tokens is toks_a and rb.tokens is toks_b  # object identity
+    # server A is empty and untouched otherwise
+    assert not sa._queue and not sa._any_active()
+
+
+def test_extract_adopt_queued_and_embeds(two_servers):
+    """A never-admitted queued request migrates (no rng to carry), and the
+    embeddings privacy entry migrates by embedding its generated tail on
+    the target — both token-exact."""
+    params, sa, sb = two_servers
+    rng = np.random.default_rng(72)
+    p1 = rng.integers(1, CFG.vocab_size, 4).astype(np.int32)
+    p2 = rng.integers(1, CFG.vocab_size, 5).astype(np.int32)
+    rq = sa.submit(p1, 8, temperature=0.7, seed=3)  # stays queued: no step
+    re = sa.submit_embedding(sa.engine.embed_prompt(p2)[0], 10)
+    for _ in range(4):
+        sa.step()  # admits + decodes re; rq admits too
+    st_e = sa.extract(re)
+    assert st_e.embeds is not None and st_e.tail.size == len(re.tokens)
+    sb.adopt(st_e, re)
+    assert sb.result(re) == oracle(params, p2, 10)
+    # rq may have admitted by now; extract regardless and finish on B
+    st_q = sa.extract(rq)
+    sb.adopt(st_q, rq)
+    assert sb.result(rq) == oracle(params, p1, 8, temperature=0.7, seed=3)
+
+
+def test_extract_rejects_foreign_and_finished(two_servers):
+    params, sa, sb = two_servers
+    rng = np.random.default_rng(73)
+    p = rng.integers(1, CFG.vocab_size, 4).astype(np.int32)
+    r = sa.submit(p, 4)
+    with pytest.raises(ValueError, match="not held"):
+        sb.extract(r)
+    sa.run_until_idle()
+    assert r.done
+    with pytest.raises(ValueError, match="finished"):
+        sa.extract(r)
+
+
+def test_adopt_refuses_oversized_resume(two_servers):
+    """A resumed prompt (original + generated) that cannot fit the target's
+    capacity is refused with a typed ValueError BEFORE any mutation — the
+    router treats it as 'try another survivor'."""
+    params, sa, sb = two_servers
+    rng = np.random.default_rng(74)
+    p = rng.integers(1, CFG.vocab_size, 5).astype(np.int32)
+    r = sa.submit(p, 12)
+    for _ in range(3):
+        sa.step()
+    st = sa.extract(r)
+    tiny = PipelineEngine(
+        CFG, params, num_stages=2, devices=jax.devices()[:2],
+        cache_dtype=jnp.float32,
+    ).serve(capacity=16)
+    with pytest.raises(ValueError, match="capacity"):
+        tiny.adopt(st, r)
+    assert not r.done and r.error is None  # still adoptable elsewhere
+    sb.adopt(st, r)
+    assert sb.result(r) == oracle(params, p, 12)
+
+
+def test_migrated_request_snapshot_roundtrip(two_servers, tmp_path):
+    """A request snapshotted AFTER a migration restores token-exactly: the
+    snapshot carries the migration bookkeeping (``baked`` — tokens folded
+    into the resumed prompt) so the restored mirrors line up."""
+    params, sa, sb = two_servers
+    rng = np.random.default_rng(75)
+    p = rng.integers(1, CFG.vocab_size, 4).astype(np.int32)
+    r = sa.submit(p, 12, temperature=1.1, seed=9)
+    for _ in range(4):
+        sa.step()
+    pre = len(r.tokens)
+    assert pre > 0
+    sb.adopt(sa.extract(r), r)
+    for _ in range(3):
+        sb.step()  # re-admitted and decoding on B (baked > 0 now)
+    assert r.baked == pre
+    path = str(tmp_path / "migrated_snap")
+    save_snapshot(sb.snapshot(), path)
+    srv2 = PipelineServer.restore(sb.engine, load_snapshot(path))
+    got = next(
+        x for x in list(srv2._rows) + list(srv2._queue)
+        if x is not None and np.array_equal(
+            x.prompt[: len(p)], p
+        )
+    )
+    assert got.baked == pre
+    srv2.run_until_idle()
+    assert got.tokens == oracle(params, p, 12, temperature=1.1, seed=9)
+    srv2.close()
+
+
+def test_extract_adopt_chunked_admission_rng_carry(two_servers):
+    """A migrated SAMPLED request whose resumed prompt crosses the target's
+    ``prefill_chunk`` re-admits through the CHUNKED path: the carried chain
+    is stored unsplit by ``serve_admit_finish`` (the first decode commit
+    performs the next split) — still token-identical to the uninterrupted
+    sampled oracle."""
+    params, sa, sb = two_servers
+    src = sa.engine.serve(capacity=64, prefill_chunk=8)
+    dst = sb.engine.serve(capacity=64, prefill_chunk=8)
+    rng = np.random.default_rng(76)
+    p = rng.integers(1, CFG.vocab_size, 12).astype(np.int32)  # bucket 16 > 8
+    r = src.submit(p, 12, temperature=0.9, seed=4)
+    for _ in range(5):
+        src.step()
+    assert r.tokens, "must be mid-decode"
+    st = src.extract(r)
+    assert st.rng is not None
+    dst.adopt(st, r)
+    assert dst.result(r) == oracle(params, p, 12, temperature=0.9, seed=4)
+    src.close()
+    dst.close()
