@@ -212,23 +212,30 @@ def _serve_control(eng, srv, line: str, args):
             print(f"bad placement: {e}", file=sys.stderr)
             return srv
         def build():
+            # every serve kwarg reads the LIVE server, not args: a
+            # --restore'd daemon's config came from the snapshot and may
+            # not be on the command line at all — re-sharding must not
+            # silently reset capacity/speculation/paged mode to the
+            # argparse defaults. (trace_path stays args-sourced: an ops
+            # knob the live server only holds as an opened writer.)
             return eng.serve(
-                capacity=args.capacity,
-                batch_per_slot=args.batch_per_slot,
-                prefill_chunk=args.prefill_chunk,
-                top_k=args.top_k,
-                top_p=args.top_p,
+                capacity=srv.capacity,
+                batch_per_slot=srv.batch_per_slot,
+                chunk_cycles=srv.chunk_cycles,
+                prefill_chunk=srv.prefill_chunk,
+                pipeline_depth=srv.pipeline_depth,
+                top_k=srv.top_k,
+                top_p=srv.top_p,
                 trace_path=getattr(args, "trace_path", None),
-                speculate=getattr(args, "speculate", 0),
-                spec_ngram=getattr(args, "spec_ngram", 3),
-                max_queue=getattr(args, "max_queue", 0) or None,
-                default_deadline_s=(
-                    getattr(args, "default_deadline", 0.0) or None
-                ),
-                snapshot_every_s=(
-                    getattr(args, "snapshot_every", 0.0) or None
-                ),
-                snapshot_path=getattr(args, "snapshot_dir", None),
+                speculate=srv.speculate,
+                spec_ngram=srv.spec_ngram,
+                max_queue=srv.max_queue,
+                default_deadline_s=srv.default_deadline_s,
+                snapshot_every_s=srv._snapshot_every_s,
+                snapshot_path=srv._snapshot_path,
+                kv_block_size=srv.kv_block_size,
+                kv_blocks=srv.kv_blocks,
+                paged_attn=srv.paged_attn,
             )
 
         try:
@@ -362,6 +369,15 @@ def cmd_serve(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if getattr(args, "paged_attn", "auto") != "auto" and not args.kv_block_size:
+        # same fast-fail-before-model-load pattern as the kv flag pairing
+        print(
+            f"error: --paged-attn {args.paged_attn} needs paged KV serving "
+            "(--kv-block-size/--kv-blocks); dense decode has no block "
+            "tables to stream",
+            file=sys.stderr,
+        )
+        return 2
     if getattr(args, "data_parallel", 1) > 1:
         # data-parallel daemon: D replica servers over disjoint device
         # groups behind a router (runtime/replicated.py). :placement is a
@@ -403,6 +419,7 @@ def cmd_serve(args) -> int:
             snapshot_path=args.snapshot_dir,
             kv_block_size=args.kv_block_size or None,
             kv_blocks=args.kv_blocks or None,
+            paged_attn=getattr(args, "paged_attn", "auto"),
             min_replicas=getattr(args, "min_replicas", 1),
         )
         eng = srv.engines[0]
@@ -463,6 +480,8 @@ def cmd_serve(args) -> int:
                     ("kv_block_size", args.kv_block_size or None,
                      srv.kv_block_size),
                     ("kv_blocks", args.kv_blocks or None, srv.kv_blocks),
+                    ("paged_attn", getattr(args, "paged_attn", "auto"),
+                     srv.paged_attn),
                 )
                 if got != used
             ]
@@ -497,6 +516,7 @@ def cmd_serve(args) -> int:
                 snapshot_path=args.snapshot_dir,
                 kv_block_size=args.kv_block_size or None,
                 kv_blocks=args.kv_blocks or None,
+                paged_attn=getattr(args, "paged_attn", "auto"),
             )
         # srv.capacity, not args.capacity: after --restore the daemon runs
         # at the SNAPSHOT's serve_kwargs (ADVICE r5 — the banner used to
@@ -966,6 +986,18 @@ def build_parser() -> argparse.ArgumentParser:
         "reserved trash sink). KV HBM per stage is roughly kv-blocks x "
         "kv-block-size x Nkv x Dh x 2 x dtype-bytes x layers-per-stage; "
         "admission waits in queue when free blocks run out",
+    )
+    s.add_argument(
+        "--paged-attn", choices=("auto", "kernel", "xla"), default="auto",
+        dest="paged_attn",
+        help="paged decode attention implementation (with --kv-block-size/"
+        "--kv-blocks): auto = Pallas kernel on TPU for Mosaic-eligible "
+        "shapes (head_dim %% 128 == 0, block size a sublane multiple), "
+        "exact XLA gather elsewhere; kernel = require the Pallas kernel "
+        "(fails at startup if ineligible); xla = force the gather "
+        "fallback. The kernel streams only each row's mapped blocks per "
+        "decode step, so attention HBM traffic scales with blocks in "
+        "flight, not logical context",
     )
     s.add_argument(
         "--snapshot-every", type=float, default=0.0, dest="snapshot_every",

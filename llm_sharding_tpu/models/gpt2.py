@@ -170,6 +170,51 @@ def forward_layers(
     return scan_layers(layers, h, cache, positions, apply, layer_mask)
 
 
+def forward_layers_paged(
+    cfg: ModelConfig,
+    layers: Params,
+    h: jnp.ndarray,
+    k_arena: jnp.ndarray,  # [L, NB, BS, Nh, D]
+    v_arena: jnp.ndarray,
+    block_table: jnp.ndarray,  # [B, T]
+    cols: jnp.ndarray,  # [B, S]
+    kv_positions: jnp.ndarray,  # [B, T*BS]
+    positions: jnp.ndarray,  # [B, S]
+    layer_mask: Optional[jnp.ndarray] = None,
+    write_valid=True,
+    tp_axis: Optional[str] = None,
+    backend: str = "auto",
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Paged serve-decode counterpart of ``forward_layers`` (see
+    ``models/llama.forward_layers_paged`` — same contract: fresh KV lands
+    via ``write_block_kv``, attention streams the table's blocks, kpos
+    bookkeeping stays with the caller)."""
+    from ..ops.paged_attention import paged_attention, write_block_kv
+    from .stack import scan_layers_paged
+
+    wv = write_valid if isinstance(write_valid, bool) else jnp.asarray(
+        write_valid
+    )
+
+    def apply(p, valid, h, k_l, v_l):
+        out = {}
+
+        def attn_fn(q, k, v):
+            k_a, v_a = write_block_kv(
+                k_l, v_l, block_table, cols, k, v, valid=wv & valid,
+            )
+            out["k"], out["v"] = k_a, v_a
+            return paged_attention(
+                q, k_a, v_a, block_table, positions, kv_positions,
+                backend=backend,
+            )
+
+        h = attn_mlp_block(cfg, p, h, attn_fn, tp_axis)
+        return h, out["k"], out["v"]
+
+    return scan_layers_paged(layers, h, k_arena, v_arena, apply, layer_mask)
+
+
 def final_logits(cfg: ModelConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
     h = layer_norm(h, params["final_norm"], params["final_norm_bias"], cfg.layer_norm_epsilon)
     if "lm_head" in params:

@@ -205,6 +205,81 @@ def decoder_layer(
     return h, rows["k"], rows["v"]
 
 
+def paged_decoder_layer(
+    cfg: ModelConfig,
+    p: Params,  # un-stacked single-layer params
+    valid: jnp.ndarray,  # scalar bool — masked (padding) layer gate
+    h: jnp.ndarray,  # [B, S, H]
+    k_arena: jnp.ndarray,  # [NB, BS, Nkv, D] this layer's pooled blocks
+    v_arena: jnp.ndarray,
+    block_table: jnp.ndarray,  # [B, T]
+    cols: jnp.ndarray,  # [B, S] logical columns of this step's entries
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    positions: jnp.ndarray,  # [B, S] absolute query positions
+    kv_positions: jnp.ndarray,  # [B, T*BS] logical-window key positions
+    write_valid,  # scalar bool — ring-inactive microsteps gate writes
+    tp_axis: Optional[str] = None,
+    backend: str = "auto",
+):
+    """Decode-path layer over the pooled arena: the step's fresh KV lands
+    via a block-indexed scatter and attention streams exactly the blocks
+    the table names (``ops/paged_attention``) — the logical window is
+    never materialized."""
+    from ..ops.paged_attention import paged_attention, write_block_kv
+
+    out = {}
+
+    def attn_fn(q, k, v):
+        k_a, v_a = write_block_kv(
+            k_arena, v_arena, block_table, cols, k, v,
+            valid=write_valid & valid,
+        )
+        out["k"], out["v"] = k_a, v_a
+        return paged_attention(
+            q, k_a, v_a, block_table, positions, kv_positions,
+            backend=backend,
+        )
+
+    h = attn_mlp_block(cfg, p, h, cos, sin, attn_fn, tp_axis)
+    return h, out["k"], out["v"]
+
+
+def forward_layers_paged(
+    cfg: ModelConfig,
+    layers: Params,  # stacked [L, ...]
+    h: jnp.ndarray,
+    k_arena: jnp.ndarray,  # [L, NB, BS, Nkv, D]
+    v_arena: jnp.ndarray,
+    block_table: jnp.ndarray,  # [B, T]
+    cols: jnp.ndarray,  # [B, S]
+    kv_positions: jnp.ndarray,  # [B, T*BS]
+    positions: jnp.ndarray,  # [B, S]
+    layer_mask: Optional[jnp.ndarray] = None,
+    write_valid=True,
+    tp_axis: Optional[str] = None,
+    backend: str = "auto",
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Paged counterpart of ``forward_layers`` for the serve decode path:
+    scans the layer stack over the pooled arena (``stack.scan_layers_paged``)
+    instead of a materialized per-row window. Returns ``(h, k_arena,
+    v_arena)`` — kpos bookkeeping stays with the caller."""
+    from .stack import scan_layers_paged
+
+    cos, sin = rope_cos_sin(positions, cfg, dtype=jnp.float32)
+    wv = write_valid if isinstance(write_valid, bool) else jnp.asarray(
+        write_valid
+    )
+
+    def apply(p, valid, h, k_l, v_l):
+        return paged_decoder_layer(
+            cfg, p, valid, h, k_l, v_l, block_table, cols, cos, sin,
+            positions, kv_positions, wv, tp_axis, backend,
+        )
+
+    return scan_layers_paged(layers, h, k_arena, v_arena, apply, layer_mask)
+
+
 def forward_layers(
     cfg: ModelConfig,
     layers: Params,  # stacked [L, ...]

@@ -70,3 +70,43 @@ def scan_layers(
         (layers, jnp.arange(L, dtype=jnp.int32), layer_mask),
     )
     return h, KVCache(k=k_all, v=v_all, pos=kv_pos, length=cache.length + S)
+
+
+def scan_layers_paged(
+    layers,
+    h: jnp.ndarray,
+    k_arena: jnp.ndarray,  # [L, NB, BS, Nkv, D] pooled per-layer blocks
+    v_arena: jnp.ndarray,
+    apply_layer,  # (p, valid, h, k_l, v_l) -> (h, k_l, v_l)
+    layer_mask: Optional[jnp.ndarray] = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Paged analogue of ``scan_layers``: the cache is the pooled block
+    arena, and a layer's update is the tiny block-indexed scatter of this
+    step's entries (``ops/paged_attention.write_block_kv`` inside
+    ``apply_layer``) — never a full-row or full-window write. Key-position
+    bookkeeping stays with the CALLER (the serve programs own the logical
+    ``kpos`` window; there is no per-scan ``KVCache.pos`` here). Layer
+    validity is passed INTO ``apply_layer`` so masked (padding) layers
+    gate their scattered entries instead of ``where``-ing the whole arena;
+    the hidden-state gate stays here like the dense scan."""
+    L = k_arena.shape[0]
+    if layer_mask is None:
+        layer_mask = jnp.ones((L,), bool)
+
+    def body(carry, xs):
+        h, k_all, v_all = carry
+        p, l, valid = xs
+        k_l = jax.lax.dynamic_index_in_dim(k_all, l, keepdims=False)
+        v_l = jax.lax.dynamic_index_in_dim(v_all, l, keepdims=False)
+        h_new, k_l, v_l = apply_layer(p, valid, h, k_l, v_l)
+        h = jnp.where(valid, h_new, h)
+        zeros = (0,) * (k_all.ndim - 1)
+        k_all = jax.lax.dynamic_update_slice(k_all, k_l[None], (l, *zeros))
+        v_all = jax.lax.dynamic_update_slice(v_all, v_l[None], (l, *zeros))
+        return (h, k_all, v_all), None
+
+    (h, k_arena, v_arena), _ = jax.lax.scan(
+        body, (h, k_arena, v_arena),
+        (layers, jnp.arange(L, dtype=jnp.int32), layer_mask),
+    )
+    return h, k_arena, v_arena

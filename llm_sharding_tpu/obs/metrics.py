@@ -440,6 +440,30 @@ KV_WASTE_FRAC = REGISTRY.gauge(
     "to 0",
 )
 
+#: Decode-attention implementations a live server can run
+#: (``ops/paged_attention`` dispatch; "dense" = non-paged serving,
+#: "interpret" = the Pallas kernel emulated off-TPU via
+#: PAGED_FORCE_KERNEL).
+ATTN_BACKENDS = ("kernel", "interpret", "xla", "dense")
+ATTN_BACKEND = REGISTRY.gauge(
+    "server_attn_backend",
+    "Live servers by resolved decode-attention backend: kernel = the "
+    "Pallas paged kernel streaming only each row's mapped arena blocks, "
+    "xla = the exact gather fallback, interpret = the kernel emulated "
+    "off-TPU, dense = non-paged serving. One-hot over the labels for a "
+    "single-server process; a count per backend otherwise",
+    labels=("backend",),
+)
+ATTN_BLOCKS_READ = REGISTRY.counter(
+    "server_attn_blocks_read_total",
+    "KV arena blocks attended by paged decode steps, summed over live "
+    "rows and ring cycles (host-side estimate from the length mirrors: "
+    "ceil(len / block_size) per row per decode/verify step). Multiply by "
+    "block_size x Nkv x Dh x 2 x dtype bytes x layers for an "
+    "attention-bytes-per-step estimate; the dense equivalent reads "
+    "capacity slots per row regardless of length",
+)
+
 
 # -- replica supervision (runtime/replicated.py) ----------------------------
 # Defined here like the KV gauges: the failover/migration counters and the

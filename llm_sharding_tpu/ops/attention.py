@@ -72,10 +72,9 @@ def cached_attention(
 # because XLA copies the full cache operands into the selected conditional
 # branch (the README "Paged KV serving" section keeps the figure). Its
 # goal — decode HBM traffic proportional to the live prefix, not the
-# capacity — is what ``ops/paged_attention.py`` is built for: the
-# standalone op reads exactly the row's mapped blocks (XLA gather) or
-# streams them straight from the arena (Pallas kernel), with no branch
-# copy. NOTE the serve programs don't call it yet — they still gather the
-# full logical window at the shard_map boundary, so paged SERVING today
-# wins on concurrency (rows sized by actual tokens), not decode
-# bandwidth; wiring the kernel into the stage functions is future work.
+# capacity — is delivered by ``ops/paged_attention.py``, now wired through
+# the serve programs end to end: paged decode in ``parallel/serve.py``
+# writes fresh KV via a block-indexed scatter and streams exactly the
+# row's mapped blocks from the pooled arena (Pallas kernel; the XLA
+# gather inside the op is the exact CPU fallback), with no branch copy
+# and no materialized window.

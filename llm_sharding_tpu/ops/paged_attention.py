@@ -32,18 +32,28 @@ attention over that layout:
 
 The retired ``bucketed_decode_attention`` (the decode-window ``lax.switch``
 whose branch copies made it SLOWER than full-capacity attention — see the
-measured note in README) is superseded by this op: as a STANDALONE op,
-block granularity gives the live-prefix-only HBM traffic the bucketed
-switch was after, without copying the cache into a conditional branch.
-The serve programs don't call it yet — they gather the full logical
-window at the shard_map boundary (exact, but full-window traffic), so the
-serving win today is concurrency, not decode bandwidth; wiring
-``paged_attention_tpu`` into the stage functions is future work.
+measured note in README) is superseded by this op: block granularity gives
+the live-prefix-only HBM traffic the bucketed switch was after, without
+copying the cache into a conditional branch. The SERVE programs call it
+too: ``parallel/serve.serve_chunk`` / ``serve_verify`` route decode-step
+attention through ``paged_attention(backend=...)`` directly on the pooled
+arena (new KV entries land via ``write_block_kv`` — a block-indexed
+scatter, never a full-window round trip), so per-step attention HBM
+traffic scales with the blocks a row actually owns. The XLA gather path
+remains the bit-exact CPU/tier-1 fallback behind the same dispatch.
+
+Backend selection (``paged_attention``'s ``backend=`` + the
+``PAGED_FORCE_KERNEL`` env var): ``auto`` picks the Pallas kernel on TPU
+for Mosaic-eligible shapes and the XLA gather elsewhere; ``kernel``/
+``xla`` force a path; ``interpret`` runs the Pallas kernel in interpret
+mode on any backend — how CI exercises the kernel code path through the
+serve programs on the CPU mesh every PR.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -54,6 +64,38 @@ from .attention import cached_attention
 from .. import _compat
 
 NEG_INF = -1e30  # python float: jnp constants can't be captured by kernels
+
+#: Valid values for ``paged_attention(backend=)`` and the
+#: ``PAGED_FORCE_KERNEL`` env override ("1" is accepted as "kernel").
+BACKENDS = ("auto", "kernel", "xla", "interpret")
+
+
+def forced_backend() -> str | None:
+    """The ``PAGED_FORCE_KERNEL`` env override, validated, or None. Read
+    per call (not at import): tests and CI set it around a run. It only
+    overrides ``backend="auto"`` — an explicit caller choice wins."""
+    raw = os.environ.get("PAGED_FORCE_KERNEL", "").strip().lower()
+    if not raw:
+        return None
+    if raw == "1":
+        return "kernel"
+    if raw not in ("kernel", "xla", "interpret"):
+        raise ValueError(
+            f"PAGED_FORCE_KERNEL={raw!r}: expected kernel, xla, "
+            f"interpret or 1"
+        )
+    return raw
+
+
+def kernel_eligible(head_dim: int, block_size: int, cache_dtype) -> bool:
+    """Mosaic-layout eligibility of the real (non-interpret) kernel:
+    the (BS, D) block tiles as (sublane, 128) — D must be a lane multiple
+    and BS a sublane multiple for the CACHE dtype (8 at 4 bytes, 16 at 2,
+    32 at 1). Shared by the trace-time dispatch below and the host-side
+    serve validation (``runtime/server.py``), so ``--paged-attn kernel``
+    fails loud at construction instead of as a Mosaic error mid-serve."""
+    sublane = 32 // max(jnp.dtype(cache_dtype).itemsize, 1)
+    return head_dim % 128 == 0 and block_size % sublane == 0
 
 
 def gather_block_kv(
@@ -79,6 +121,47 @@ def gather_block_kv(
         k.reshape(B, T * BS, *k_arena.shape[2:]),
         v.reshape(B, T * BS, *v_arena.shape[2:]),
     )
+
+
+def write_block_kv(
+    k_arena: jnp.ndarray,  # [NB, BS, Nkv, D] pooled key blocks
+    v_arena: jnp.ndarray,  # [NB, BS, Nkv, D]
+    block_table: jnp.ndarray,  # [B, T] int32 arena block ids per row
+    cols: jnp.ndarray,  # [B, S] int32 logical columns of the new entries
+    k_new: jnp.ndarray,  # [B, S, Nkv, D]
+    v_new: jnp.ndarray,  # [B, S, Nkv, D]
+    valid=None,  # scalar or [B, S] bool — False entries keep old contents
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter a step's fresh KV entries into their OWNING arena blocks —
+    the decode-path replacement for the full-window gather→update→scatter
+    round trip: per step the arena update is ``B × S`` slots, not the
+    logical window. Column ``c`` of row ``b`` lives in arena block
+    ``block_table[b, c // BS]`` at slot ``c % BS``; trash-mapped columns
+    (table entry 0) land in the shared trash sink, which absorbs them
+    (parked-slot garbage, spec-verify overflow past a row's mapped budget
+    — the sink's contents are never attended: readers gate entry 0 to
+    zeros and position masking excludes them anyway).
+
+    ``valid`` gates at ENTRY granularity — invalid entries write back the
+    values just gathered from the arena, so ring-inactive microsteps and
+    masked pipeline layers stay no-ops without a full-arena ``where``
+    (which would copy the pool per layer per microstep). Collisions
+    (several rows trash-mapped onto the same slot) resolve last-wins:
+    only the sink can collide, and it is a garbage sink by contract."""
+    BS = k_arena.shape[1]
+    W = block_table.shape[1] * BS
+    cols = jnp.clip(cols, 0, W - 1)  # defense: XLA clamps, tables don't
+    blk = jnp.take_along_axis(block_table, cols // BS, axis=1)  # [B, S]
+    slot = cols % BS
+    kn = k_new.astype(k_arena.dtype)
+    vn = v_new.astype(v_arena.dtype)
+    if valid is not None:
+        keep = jnp.asarray(valid)
+        if keep.ndim:  # [B, S] → broadcast over the (Nkv, D) entry dims
+            keep = keep[..., None, None]
+        kn = jnp.where(keep, kn, k_arena[blk, slot])
+        vn = jnp.where(keep, vn, v_arena[blk, slot])
+    return k_arena.at[blk, slot].set(kn), v_arena.at[blk, slot].set(vn)
 
 
 def paged_attention_xla(
@@ -252,22 +335,52 @@ def paged_attention(
     q_positions: jnp.ndarray,
     kv_positions: jnp.ndarray,
     scale: float | None = None,
+    backend: str = "auto",
 ) -> jnp.ndarray:
     """Backend dispatch: the Pallas kernel on TPU for MXU-aligned shapes,
     the exact XLA gather path otherwise (CPU meshes, ragged head dims,
-    sub-sublane block sizes). Identical numerics either way
-    (interpret-mode tested on CPU)."""
+    sub-sublane block sizes — see ``kernel_eligible``). ``backend`` pins a
+    path (``kernel`` / ``xla`` / ``interpret``); ``PAGED_FORCE_KERNEL``
+    overrides ``auto`` only, so an explicit caller choice always wins.
+    Identical numerics either way (interpret-mode tested on CPU)."""
+    if backend not in BACKENDS:
+        raise ValueError(
+            f"paged_attention backend {backend!r}: expected one of "
+            f"{BACKENDS}"
+        )
+    if backend == "auto":
+        backend = forced_backend() or "auto"
     D = q.shape[-1]
     BS = k_arena.shape[1]
-    # Mosaic tiles the (BS, D) block as (sublane, 128): D must be a lane
-    # multiple and BS a sublane multiple for the CACHE dtype (8 at 4
-    # bytes, 16 at 2, 32 at 1) — the tiny-block CI configs (BS=4) fall
-    # back to the exact gather path instead of a Mosaic layout error
-    sublane = 32 // max(jnp.dtype(k_arena.dtype).itemsize, 1)
-    use_pallas = (
-        jax.default_backend() == "tpu"
-        and D % 128 == 0
-        and BS % sublane == 0
+    if backend == "interpret":
+        return paged_attention_tpu(
+            q, k_arena, v_arena, block_table, q_positions, kv_positions,
+            scale, interpret=True,
+        )
+    if backend == "kernel":
+        # curated here too, not only in the serve-side resolution: a
+        # lingering PAGED_FORCE_KERNEL=kernel reaching a CPU host (or a
+        # Mosaic-ineligible shape on TPU) through backend="auto" would
+        # otherwise surface as a raw Pallas/Mosaic lowering error
+        if jax.default_backend() != "tpu":
+            raise ValueError(
+                f"paged_attention backend 'kernel' requires a TPU backend "
+                f"(got {jax.default_backend()}); use backend='interpret' "
+                f"(or PAGED_FORCE_KERNEL=interpret) to emulate the kernel "
+                f"off-TPU"
+            )
+        if not kernel_eligible(D, BS, k_arena.dtype):
+            raise ValueError(
+                f"paged_attention backend 'kernel': head_dim={D} / "
+                f"block_size={BS} are not Mosaic-eligible for cache dtype "
+                f"{jnp.dtype(k_arena.dtype).name} (head_dim must be a "
+                f"multiple of 128 and the block size a sublane multiple "
+                f"— see kernel_eligible); use backend='auto' or 'xla'"
+            )
+    use_pallas = backend == "kernel" or (
+        backend == "auto"
+        and jax.default_backend() == "tpu"
+        and kernel_eligible(D, BS, k_arena.dtype)
     )
     if use_pallas:
         return paged_attention_tpu(

@@ -69,20 +69,32 @@ class ModelFns(NamedTuple):
     """Architecture dispatch for the pipeline (llama / gpt2)."""
 
     stage: Any  # (cfg, layers, h, cache, positions, mask) -> (h, cache)
+    # paged serve-decode stage over the pooled arena (no materialized
+    # window): (cfg, layers, h, k_arena, v_arena, tbl, cols, kv_pos,
+    # positions, mask, write_valid, backend) -> (h, k_arena, v_arena)
+    stage_paged: Any = None
 
 
 def model_fns(cfg: ModelConfig, tp_axis: Optional[str] = None) -> ModelFns:
     if cfg.model_type == "llama":
-        fwd = llama.forward_layers
+        fwd, fwd_paged = llama.forward_layers, llama.forward_layers_paged
     elif cfg.model_type == "gpt2":
-        fwd = gpt2.forward_layers
+        fwd, fwd_paged = gpt2.forward_layers, gpt2.forward_layers_paged
     else:
         raise ValueError(f"unsupported model_type: {cfg.model_type!r}")
 
     def stage(cfg_, layers, h, cache, positions, mask):
         return fwd(cfg_, layers, h, cache, positions, mask, tp_axis=tp_axis)
 
-    return ModelFns(stage=stage)
+    def stage_paged(cfg_, layers, h, k_arena, v_arena, tbl, cols, kv_pos,
+                    positions, mask, write_valid=True, backend="auto"):
+        return fwd_paged(
+            cfg_, layers, h, k_arena, v_arena, tbl, cols, kv_pos,
+            positions, mask, write_valid=write_valid, tp_axis=tp_axis,
+            backend=backend,
+        )
+
+    return ModelFns(stage=stage, stage_paged=stage_paged)
 
 
 def mesh_axis_sizes(mesh: Mesh) -> tuple[int, int, int]:
@@ -156,6 +168,31 @@ def ring_chain(fns, cfg, layers, lmask, sidx, ring, num_stages, h, cache, positi
         return h, cache
 
     return jax.lax.fori_loop(0, num_stages, micro, (h, cache))
+
+
+def ring_chain_paged(fns, cfg, layers, lmask, sidx, ring, num_stages, h,
+                     k_arena, v_arena, tbl, cols, kv_positions, positions,
+                     backend="auto"):
+    """``ring_chain`` over the pooled paged arena (the serve programs'
+    kernel decode path): the per-microstep activity gate moves from a
+    whole-cache ``_tree_where`` (which would copy the ARENA — the whole
+    pool, not one slot's window — every microstep) down to
+    ``write_block_kv``'s per-entry ``valid``, so an inactive microstep's
+    arena update writes back the values it just read. The hidden-state
+    gate is unchanged."""
+
+    def micro(m, carry):
+        h, ka, va = carry
+        active = m == sidx
+        h_new, ka, va = fns.stage_paged(
+            cfg, layers, h, ka, va, tbl, cols, kv_positions, positions,
+            lmask, write_valid=active, backend=backend,
+        )
+        h = jnp.where(active, h_new, h)
+        h = jax.lax.ppermute(h, PIPE_AXIS, ring)
+        return h, ka, va
+
+    return jax.lax.fori_loop(0, num_stages, micro, (h, k_arena, v_arena))
 
 
 def validate_request(

@@ -49,7 +49,9 @@ from .head import (
     seed_chain_init, sp_embed, sp_next_token, sp_sample_rows,
 )
 from .mesh import PIPE_AXIS
-from .pipeline import model_fns, ring_chain, stage_layer_specs
+from .pipeline import (
+    model_fns, ring_chain, ring_chain_paged, stage_layer_specs,
+)
 from .tensor import TENSOR_AXIS
 from .._compat import shard_map
 
@@ -132,30 +134,51 @@ def state_specs(state: ServeState, tp: int = 1) -> ServeState:
     )
 
 
-# ---- paged-KV window assembly ---------------------------------------------
+# ---- paged-KV window assembly (PREFILL paths only) ------------------------
 # Inside the shard_map bodies a slot's rows are normally a dynamic SLICE of
-# the per-row cache; in paged mode they are a GATHER through the rows' block
-# tables instead — the logical [Lp, Bs, W, Nkv, Dh] window the stage fns see
-# is identical either way, which is why paged greedy serving is
-# token-identical to dense by construction (attention depends only on the
-# gathered values and the per-row logical kpos). The scatter back may hit
-# duplicate arena blocks across rows — shared prefix blocks (every duplicate
-# writes the identical broadcast values) and the trash block (a garbage
-# sink) — so last-wins scatter order is immaterial.
+# the per-row cache; the paged PREFILL paths (serve_admit's fresh-window
+# scatter, serve_prefill_chunk's gather→write→scatter) instead round-trip
+# the logical [Lp, Bs, W, Nkv, Dh] window through the rows' block tables —
+# exact by construction (attention depends only on the gathered values and
+# the per-row logical kpos), and amortized over the whole chunk of prompt
+# tokens it processes. The DECODE paths (serve_chunk microsteps,
+# serve_verify traversals) no longer materialize the window at all: fresh
+# KV lands via ops/paged_attention.write_block_kv (a per-entry scatter into
+# the owning blocks) and attention runs straight off the arena through
+# ``paged_attention`` — the Pallas kernel streams exactly the blocks a row
+# owns (per-step HBM traffic ∝ blocks in flight), the XLA backend gathers
+# inside the op (the bit-exact CPU/tier-1 fallback). The scatter back may
+# hit duplicate arena blocks across rows — shared prefix blocks (every
+# duplicate writes the identical broadcast values) and the trash block (a
+# garbage sink) — so last-wins scatter order is immaterial.
+
+
+def _gather_window(k_arena, v_arena, tbl, block_size):
+    """Assemble a slot's logical K and V windows from the pooled arena:
+    ``[Lp, NB, BS, ...] , tbl [Bs, T] -> 2 × [Lp, Bs, T*BS, ...]`` — THE
+    shared helper for every surviving full-window consumer (prefill-chunk
+    continuation, admit's doc reference, host snapshot tooling).
+
+    Trash-zeroing contract (stated once, here): trash-mapped entries
+    (block 0) gather as ZEROS, not the trash block's contents. Parked rows
+    keep scattering garbage K/V there every microstep, and while attention
+    masks those positions to probability exactly 0, bf16 garbage can feed
+    back to ±Inf over a long run and 0 × Inf = NaN would then contaminate
+    every live row through the one SHARED block — a channel dense mode
+    (private columns) doesn't have. Zeroing is token-identical: the masked
+    positions contribute 0 either way, and in-program writes (admit prompt
+    KV, prefill-chunk continuations) land AFTER the gather, so fresh
+    values are never affected. ``ops/paged_attention`` applies the same
+    contract on the decode paths (``gather_block_kv`` zeroes at the
+    gather; the Pallas kernel gates trash blocks at the stream)."""
+    return (
+        _gather_pages(k_arena, tbl, block_size),
+        _gather_pages(v_arena, tbl, block_size),
+    )
 
 
 def _gather_pages(arena, tbl, block_size):
-    """``arena [Lp, NB, BS, ...] , tbl [Bs, T] -> [Lp, Bs, T*BS, ...]``.
-
-    Trash-mapped entries (block 0) gather as ZEROS, not the trash block's
-    contents: parked rows keep scattering garbage K/V there every
-    microstep, and while attention masks those positions to probability
-    exactly 0, bf16 garbage can feed back to ±Inf over a long run and
-    0 × Inf = NaN would then contaminate every live row through the one
-    SHARED block — a channel dense mode (private columns) doesn't have.
-    Zeroing is token-identical: the masked positions contribute 0 either
-    way, and in-program writes (admit prompt KV, spec-verify scratch) land
-    AFTER the gather, so fresh values are never affected."""
+    """One-array gather behind ``_gather_window`` (see its contract)."""
     g = arena[:, tbl]  # [Lp, Bs, T, BS, ...]
     Lp, Bs, T = g.shape[0], g.shape[1], g.shape[2]
     live = (tbl != 0).reshape(1, Bs, T, 1, *([1] * (g.ndim - 4)))
@@ -689,8 +712,7 @@ def serve_prefill_chunk(
         row0 = slot * Bs
         if block_size:
             tbl = _slot_tables(st, row0, Bs)
-            k_rows = _gather_pages(st.k, tbl, block_size)
-            v_rows = _gather_pages(st.v, tbl, block_size)
+            k_rows, v_rows = _gather_window(st.k, st.v, tbl, block_size)
         else:
             k_rows = jax.lax.dynamic_slice_in_dim(st.k, row0, Bs, axis=1)
             v_rows = jax.lax.dynamic_slice_in_dim(st.v, row0, Bs, axis=1)
@@ -872,7 +894,7 @@ def serve_admit_finish(
     jax.jit,
     static_argnames=(
         "cfg", "mesh", "num_stages", "n_micro", "sampling", "filtering", "tp",
-        "block_size",
+        "block_size", "attn",
     ),
     donate_argnums=(5,),  # see serve_admit
 )
@@ -889,6 +911,11 @@ def serve_chunk(
     filtering: bool = True,
     tp: int = 1,
     block_size: int = 0,  # static: paged-KV block size (0 = dense state)
+    attn: str = "xla",  # static: paged attention backend for the decode
+    #   microsteps — "xla" (exact gather inside the op, the CPU/tier-1
+    #   fallback), "kernel" (Pallas: streams only each row's mapped
+    #   blocks) or "interpret" (the kernel emulated, CI on CPU). Resolved
+    #   host-side by runtime/server.py; ignored in dense mode
 ):
     """Run ``n_micro`` interleaved microsteps on the live state. Returns
     ``(state, log)`` where ``log`` is ``[n_micro, Bs]`` int32 — the token
@@ -945,24 +972,6 @@ def serve_chunk(
             slot_active = ~jnp.all(done_served)
             advance = valid_now & slot_active
 
-            if block_size:
-                tbl_r = _slot_tables(s, row0, Bs)
-                cache_r = KVCache(
-                    k=_gather_pages(s.k, tbl_r, block_size),
-                    v=_gather_pages(s.v, tbl_r, block_size),
-                    pos=jax.lax.dynamic_slice_in_dim(s.kpos, row0, Bs, axis=0),
-                    length=off_r,
-                )
-            else:
-                cache_r = KVCache(
-                    k=jax.lax.dynamic_slice_in_dim(s.k, row0, Bs, axis=1),
-                    v=jax.lax.dynamic_slice_in_dim(s.v, row0, Bs, axis=1),
-                    pos=jax.lax.dynamic_slice_in_dim(s.kpos, row0, Bs, axis=0),
-                    length=off_r,
-                )
-            h_new, cache_r_new = fns.stage(
-                cfg, layers, h_in, cache_r, pos_rows[:, None], lmask
-            )
             # Unconditional commit: a garbage write lands at an offset the
             # next real serve overwrites (offsets only advance on `advance`).
             # Paged mode keeps this safe two ways: a LIVE row's write offset
@@ -976,12 +985,40 @@ def serve_chunk(
                 )
 
             if block_size:
-                k_st = _scatter_pages(s.k, tbl_r, cache_r_new.k, block_size)
-                v_st = _scatter_pages(s.v, tbl_r, cache_r_new.v, block_size)
+                # Paged decode: NO materialized window. The step's single
+                # fresh KV entry per row scatters into the block the table
+                # owns at column off_r (write_block_kv inside stage_paged)
+                # and attention runs straight off the arena — the Pallas
+                # kernel streams only the slot's mapped blocks; the XLA
+                # backend gathers inside the op (exact fallback). Key
+                # positions are recorded at the write column exactly as
+                # scan_layers does for the dense window.
+                tbl_r = _slot_tables(s, row0, Bs)
+                kpos_rows = jax.lax.dynamic_slice_in_dim(
+                    s.kpos, row0, Bs, axis=0
+                )
+                kv_pos = jax.lax.dynamic_update_slice(
+                    kpos_rows, pos_rows[:, None], (0, off_r)
+                )
+                h_new, k_st, v_st = fns.stage_paged(
+                    cfg, layers, h_in, s.k, s.v, tbl_r,
+                    jnp.broadcast_to(off_r, (Bs, 1)), kv_pos,
+                    pos_rows[:, None], lmask, backend=attn,
+                )
+                kpos_st = upd(s.kpos, kv_pos, 0)
             else:
+                cache_r = KVCache(
+                    k=jax.lax.dynamic_slice_in_dim(s.k, row0, Bs, axis=1),
+                    v=jax.lax.dynamic_slice_in_dim(s.v, row0, Bs, axis=1),
+                    pos=jax.lax.dynamic_slice_in_dim(s.kpos, row0, Bs, axis=0),
+                    length=off_r,
+                )
+                h_new, cache_r_new = fns.stage(
+                    cfg, layers, h_in, cache_r, pos_rows[:, None], lmask
+                )
                 k_st = upd(s.k, cache_r_new.k, 1)
                 v_st = upd(s.v, cache_r_new.v, 1)
-            kpos_st = upd(s.kpos, cache_r_new.pos, 0)
+                kpos_st = upd(s.kpos, cache_r_new.pos, 0)
             write_off = jnp.where(
                 advance, s.write_off.at[r].add(1), s.write_off
             )
@@ -1107,7 +1144,7 @@ def serve_chunk(
     jax.jit,
     static_argnames=(
         "cfg", "mesh", "num_stages", "K", "sampling", "filtering", "tp",
-        "block_size",
+        "block_size", "attn",
     ),
     donate_argnums=(5,),  # see serve_admit
 )
@@ -1132,6 +1169,7 @@ def serve_verify(
     filtering: bool = True,
     tp: int = 1,
     block_size: int = 0,  # static: paged-KV block size (0 = dense state)
+    attn: str = "xla",  # static: paged attention backend (see serve_chunk)
 ):
     """Speculative verify for one slot: ONE parked-pipeline ring traversal
     over the K+1 draft positions per row — a tiny prefill (the ``serve_admit``
@@ -1151,12 +1189,17 @@ def serve_verify(
     distribution on every stage (like ``sp_sample_rows``'s filtering path);
     greedy stays shard-local.
 
-    KV rollback: the traversal writes its K+1 entries into the SCRATCH
-    columns at the top of the cache (the server allocates ``K+1`` columns
-    over its usable capacity); the accepted prefix is then compacted to each
-    row's canonical columns at ``cache_off`` and the scratch key positions
-    rewound to the sentinel — rejected positions are logically discarded
-    (never attended) without copying live state. ``pos_slots``/``lengths``/
+    KV rollback — dense: the traversal writes its K+1 entries into the
+    SCRATCH columns at the top of the cache (the server allocates ``K+1``
+    columns over its usable capacity); the accepted prefix is then
+    compacted to each row's canonical columns at ``cache_off`` and the
+    scratch key positions rewound to the sentinel — rejected positions are
+    logically discarded (never attended) without copying live state.
+    Paged: no scratch at all — entries scatter straight into each row's
+    canonical columns during the traversal (``write_block_kv`` handles
+    per-row columns where the dense path's shared write offset cannot;
+    overflow past the mapped budget is absorbed by the trash block) and
+    rollback is purely the position rewind. ``pos_slots``/``lengths``/
     ``done``/``out``/``rng`` update exactly as if the committed tokens had
     arrived one microstep at a time, so snapshots taken between steps stay
     restore-compatible."""
@@ -1199,20 +1242,37 @@ def serve_verify(
             out_rows, jnp.clip(len_rows - 1, 0, C_total - 1)[:, None], axis=1
         )[:, 0]
 
-        # Paged note: the scratch columns at the top of the window live in
-        # TRASH-mapped table entries for every row — legitimate because
-        # scratch never persists across programs (this traversal writes the
-        # K+1 entries locally, the compaction below reads them from the
-        # SAME local window, and the scratch kpos is rewound to the
-        # sentinel before the scatter), so no dedicated scratch blocks are
-        # ever allocated.
+        toks_in = jnp.concatenate([tok_pend[:, None], draft], axis=1)
+        positions = jnp.where(
+            done_rows[:, None], POS_SENTINEL,
+            pos_rows[:, None] + iota[None, :],
+        )
+        h = sp_embed(cfg, hd, toks_in, positions)
         if block_size:
+            # Paged verify: NO materialized window and NO scratch columns —
+            # the K+1 in-flight entries scatter DIRECTLY into each row's
+            # canonical columns ``cache_off + i`` during the traversal
+            # (per-row columns are fine for write_block_kv's scatter, where
+            # the dense path's shared-offset dynamic_update_slice forced
+            # the scratch/compaction dance). Entries past a row's mapped
+            # budget land in the trash block, which absorbs them: only
+            # never-committable positions (cap_commits bounds the run by
+            # the remaining budget) can overflow, and the attention of any
+            # committable query never reads them. The traversal's queries
+            # see the in-flight entries through ``kv_pos`` — a TEMPORARY
+            # position window; the state's kpos update below keeps only
+            # the accepted prefix (rollback = position rewind, no copy).
             tbl = _slot_tables(st, row0, Bs)
-            cache = KVCache(
-                k=_gather_pages(st.k, tbl, block_size),
-                v=_gather_pages(st.v, tbl, block_size),
-                pos=jax.lax.dynamic_slice_in_dim(st.kpos, row0, Bs, axis=0),
-                length=jnp.asarray(scratch, jnp.int32),
+            cols = cache_off[:, None] + iota[None, :]  # [Bs, K+1]
+            rowsel = jnp.arange(Bs, dtype=jnp.int32)[:, None]
+            colsel = jnp.clip(cols, 0, C_total - 1)
+            kpos_rows = jax.lax.dynamic_slice_in_dim(
+                st.kpos, row0, Bs, axis=0
+            )
+            kv_pos = kpos_rows.at[rowsel, colsel].set(positions)
+            h, k_full, v_full = ring_chain_paged(
+                fns, cfg, layers, lmask, sidx, ring, num_stages, h,
+                st.k, st.v, tbl, cols, kv_pos, positions, backend=attn,
             )
         else:
             cache = KVCache(
@@ -1221,16 +1281,10 @@ def serve_verify(
                 pos=jax.lax.dynamic_slice_in_dim(st.kpos, row0, Bs, axis=0),
                 length=jnp.asarray(scratch, jnp.int32),
             )
-        toks_in = jnp.concatenate([tok_pend[:, None], draft], axis=1)
-        positions = jnp.where(
-            done_rows[:, None], POS_SENTINEL,
-            pos_rows[:, None] + iota[None, :],
-        )
-        h = sp_embed(cfg, hd, toks_in, positions)
-        h, cache = ring_chain(
-            fns, cfg, layers, lmask, sidx, ring, num_stages, h, cache,
-            positions,
-        )
+            h, cache = ring_chain(
+                fns, cfg, layers, lmask, sidx, ring, num_stages, h, cache,
+                positions,
+            )
         # final-depth hidden for ALL K+1 positions, replicated from stage 0
         # (the block lands back on its origin after the full ring trip)
         hf = psum_from(h.reshape(Bs * (K + 1), -1), 0)
@@ -1305,54 +1359,63 @@ def serve_verify(
         vals = jnp.take_along_axis(commit, jnp.clip(rel, 0, K), axis=1)
         out_rows = jnp.where(in_run, vals, out_rows)
 
-        # ---- KV rollback/compaction (see docstring) ----
-        chunk_k = jax.lax.dynamic_slice_in_dim(
-            cache.k, scratch, K + 1, axis=2
-        )
-        chunk_v = jax.lax.dynamic_slice_in_dim(
-            cache.v, scratch, K + 1, axis=2
-        )
-
-        def compact(row_kv, row_chunk, start):
-            return jax.lax.dynamic_update_slice(
-                row_kv, row_chunk, (0, start, 0, 0)
-            )
-
-        k_slot = jax.vmap(compact, in_axes=(1, 1, 0), out_axes=1)(
-            cache.k, chunk_k, cache_off
-        )
-        v_slot = jax.vmap(compact, in_axes=(1, 1, 0), out_axes=1)(
-            cache.v, chunk_v, cache_off
-        )
+        # ---- KV rollback (see docstring) ----
         row_pos = jnp.where(
             iota[None, :] < c[:, None], pos_rows[:, None] + iota[None, :],
             POS_SENTINEL,
         ).astype(jnp.int32)
-        pos_slot = jax.vmap(
-            lambda p_row, vals_row, start: jax.lax.dynamic_update_slice(
-                p_row, vals_row, (start,)
-            )
-        )(cache.pos, row_pos, cache_off)
-        pos_slot = jax.lax.dynamic_update_slice(
-            pos_slot,
-            jnp.full((Bs, K + 1), POS_SENTINEL, jnp.int32),
-            (0, scratch),
-        )
-
-        if sampling:
-            rng_new = jnp.where((c > 0)[:, None], new_keys, rng_rows)
-        inject_pending = st.inject_pending.at[rows].set(False)
-
         if block_size:
-            k_full = _scatter_pages(st.k, tbl, k_slot, block_size)
-            v_full = _scatter_pages(st.v, tbl, v_slot, block_size)
+            # The traversal already wrote every entry at its canonical
+            # column (k_full/v_full above); rollback is purely the
+            # position rewind — accepted entries get their real positions,
+            # rejected ones the sentinel (their stale values sit invisible
+            # until the row's decode genuinely reaches that column and
+            # overwrites them, exactly like the dense compaction's
+            # unconditional K+1-entry copy).
+            pos_slot = kpos_rows.at[rowsel, colsel].set(row_pos)
         else:
+            # Dense compaction: the traversal wrote the K+1 entries into
+            # the SCRATCH columns at the top of the window (the shared
+            # scalar write offset cannot express per-row columns); copy
+            # them to each row's canonical columns and rewind scratch.
+            chunk_k = jax.lax.dynamic_slice_in_dim(
+                cache.k, scratch, K + 1, axis=2
+            )
+            chunk_v = jax.lax.dynamic_slice_in_dim(
+                cache.v, scratch, K + 1, axis=2
+            )
+
+            def compact(row_kv, row_chunk, start):
+                return jax.lax.dynamic_update_slice(
+                    row_kv, row_chunk, (0, start, 0, 0)
+                )
+
+            k_slot = jax.vmap(compact, in_axes=(1, 1, 0), out_axes=1)(
+                cache.k, chunk_k, cache_off
+            )
+            v_slot = jax.vmap(compact, in_axes=(1, 1, 0), out_axes=1)(
+                cache.v, chunk_v, cache_off
+            )
+            pos_slot = jax.vmap(
+                lambda p_row, vals_row, start: jax.lax.dynamic_update_slice(
+                    p_row, vals_row, (start,)
+                )
+            )(cache.pos, row_pos, cache_off)
+            pos_slot = jax.lax.dynamic_update_slice(
+                pos_slot,
+                jnp.full((Bs, K + 1), POS_SENTINEL, jnp.int32),
+                (0, scratch),
+            )
             k_full = jax.lax.dynamic_update_slice_in_dim(
                 st.k, k_slot, row0, axis=1
             )
             v_full = jax.lax.dynamic_update_slice_in_dim(
                 st.v, v_slot, row0, axis=1
             )
+
+        if sampling:
+            rng_new = jnp.where((c > 0)[:, None], new_keys, rng_rows)
+        inject_pending = st.inject_pending.at[rows].set(False)
         new = st._replace(
             k=k_full,
             v=v_full,

@@ -508,6 +508,7 @@ class PipelineEngine:
         snapshot_path: Optional[str] = None,
         kv_block_size: Optional[int] = None,
         kv_blocks: Optional[int] = None,
+        paged_attn: str = "auto",
     ):
         """Build a continuous-batching server over this engine's sharded
         arrays (≙ the reference's persistent ``run_worker_loop`` daemon,
@@ -524,6 +525,12 @@ class PipelineEngine:
         tokens committed per row per step (``runtime/spec.py``). Greedy
         output stays token-identical; decode tok/s rises with the workload's
         n-gram predictability.
+
+        ``kv_block_size``/``kv_blocks`` turn on paged KV serving (pooled
+        block arena + per-row tables); ``paged_attn`` picks its decode
+        attention implementation — ``auto`` (Pallas kernel on TPU for
+        Mosaic-eligible shapes, exact XLA gather elsewhere), ``kernel`` or
+        ``xla``. See ``ops/paged_attention.py``.
 
         Resilience knobs (see ``runtime/server.py``'s module docstring):
         ``max_queue=`` bounds the submit queue (``QueueFull`` past it),
@@ -557,6 +564,7 @@ class PipelineEngine:
             snapshot_path=snapshot_path,
             kv_block_size=kv_block_size,
             kv_blocks=kv_blocks,
+            paged_attn=paged_attn,
         )
 
     def _shared_server(self, prompt_len: int, max_new: int):

@@ -74,8 +74,9 @@ import jax
 import jax.numpy as jnp
 
 from ..obs.metrics import (
-    DEFAULT_RATE_BUCKETS, KV_BLOCKS_IN_USE, KV_BLOCKS_TOTAL, KV_WASTE_FRAC,
-    REGISTRY, record_shape_key,
+    ATTN_BACKEND, ATTN_BACKENDS, ATTN_BLOCKS_READ, DEFAULT_RATE_BUCKETS,
+    KV_BLOCKS_IN_USE, KV_BLOCKS_TOTAL, KV_WASTE_FRAC, REGISTRY,
+    record_shape_key,
 )
 from ..obs.trace import TraceWriter
 from ..parallel import serve as serve_ops
@@ -175,9 +176,16 @@ def _update_load_gauges() -> None:
     fragmentation the operator tunes ``kv_block_size`` against."""
     queued = active = 0
     kv_total = kv_used = kv_slots = kv_live = 0
+    backends = dict.fromkeys(ATTN_BACKENDS, 0)
     for s in list(_LIVE_SERVERS):
         queued += len(s._queue)
         active += sum(r is not None and not r.done for r in s._rows)
+        # like the health gauge's filter: a closed server lingering in the
+        # WeakSet (e.g. the old daemon across a :placement rebuild) must
+        # not double-count a backend — the gauge's one-hot contract for a
+        # single-server process depends on it
+        if not getattr(s, "_closed", False):
+            backends[getattr(s, "attn_impl", "dense")] += 1
         if getattr(s, "paged", False):
             kv_total += s._alloc.capacity_blocks
             kv_used += s._alloc.in_use
@@ -189,6 +197,8 @@ def _update_load_gauges() -> None:
             )
     _M_QUEUE_DEPTH.set(queued)
     _M_ACTIVE.set(active)
+    for b, n in backends.items():
+        ATTN_BACKEND.labels(backend=b).set(n)
     KV_BLOCKS_TOTAL.set(kv_total)
     KV_BLOCKS_IN_USE.set(kv_used)
     # shared prefix tokens count once per mapping row (mirror lengths are
@@ -811,6 +821,7 @@ class PipelineServer:
         snapshot_path: Optional[str] = None,
         kv_block_size: Optional[int] = None,
         kv_blocks: Optional[int] = None,
+        paged_attn: str = "auto",
     ):
         self.engine = engine
         self.cfg = engine.cfg
@@ -910,6 +921,29 @@ class PipelineServer:
                 )
         self.kv_block_size = kv_block_size
         self.kv_blocks = kv_blocks
+        # -- paged attention backend (ops/paged_attention dispatch) --------
+        # Which implementation the serve programs' decode attention runs:
+        # "kernel" (the Pallas paged kernel — streams only each row's
+        # mapped blocks, the bandwidth win), "xla" (exact gather inside
+        # the op — the CPU/tier-1 fallback) or "interpret" (the kernel
+        # emulated off-TPU; reached via PAGED_FORCE_KERNEL, how CI drives
+        # the kernel code path through the serve programs every PR).
+        # Resolved ONCE here so --paged-attn kernel fails loud at
+        # construction, not as a Mosaic error mid-serve.
+        if paged_attn not in ("auto", "kernel", "xla"):
+            raise ValueError(
+                f"paged_attn must be auto, kernel or xla, got {paged_attn!r}"
+            )
+        if paged_attn != "auto" and not self.paged:
+            raise ValueError(
+                "paged_attn is only meaningful with paged KV serving "
+                "(set kv_block_size/kv_blocks); dense decode has no block "
+                "tables to stream"
+            )
+        self.paged_attn = paged_attn
+        self.attn_impl = (
+            self._resolve_attn_impl(paged_attn) if self.paged else "dense"
+        )
         self._fault_plan = fault_plan
         if fault_retries < 0:
             raise ValueError(f"fault_retries must be >= 0, got {fault_retries}")
@@ -1030,6 +1064,68 @@ class PipelineServer:
         _LIVE_SERVERS.add(self)  # load gauges sum over live servers
         _update_health_gauge()  # one-hot shows SERVING from birth, not
         # only after the first health transition
+
+    def _resolve_attn_impl(self, requested: str) -> str:
+        """Resolve the ``paged_attn`` request to the implementation the
+        serve programs compile against: ``kernel`` / ``xla`` /
+        ``interpret``. ``auto`` picks the kernel on TPU for Mosaic-eligible
+        shapes and the exact XLA gather elsewhere; the PAGED_FORCE_KERNEL
+        env var overrides ``auto`` only (an explicit choice wins), which is
+        how CI pins ``interpret`` across a whole test run."""
+        from ..ops.paged_attention import forced_backend, kernel_eligible
+
+        on_tpu = jax.default_backend() == "tpu"
+        eligible = kernel_eligible(
+            self.cfg.head_dim_, self.kv_block_size, self.engine.cache_dtype
+        )
+
+        def check_kernel(source: str) -> None:
+            if not on_tpu:
+                raise ValueError(
+                    f"{source} requires a TPU backend (got "
+                    f"{jax.default_backend()}); use "
+                    f"PAGED_FORCE_KERNEL=interpret to exercise the kernel "
+                    f"code path off-TPU, or paged_attn='xla'"
+                )
+            if not eligible:
+                raise ValueError(
+                    f"{source}: head_dim={self.cfg.head_dim_} / "
+                    f"kv_block_size={self.kv_block_size} are not "
+                    f"Mosaic-eligible for cache dtype "
+                    f"{jnp.dtype(self.engine.cache_dtype).name} (head_dim "
+                    f"must be a multiple of 128 and the block size a "
+                    f"sublane multiple — see "
+                    f"ops/paged_attention.kernel_eligible); use "
+                    f"paged_attn='auto' or 'xla'"
+                )
+
+        if requested == "xla":
+            return "xla"
+        if requested == "kernel":
+            check_kernel("paged_attn='kernel'")
+            return "kernel"
+        forced = forced_backend()
+        if forced is not None:
+            if forced == "kernel":
+                check_kernel("PAGED_FORCE_KERNEL=kernel")
+            return forced
+        return "kernel" if (on_tpu and eligible) else "xla"
+
+    def _record_blocks_read(self, rows, steps: int = 1) -> None:
+        """Feed ``server_attn_blocks_read_total`` from the host length
+        mirrors: an estimate (mirrors trail the device by the in-flight
+        chunk) of the arena blocks each row's decode attention streams —
+        ``ceil(len / block_size)`` per row per decode/verify step. The
+        bench multiplies by block bytes × layers for its
+        attention-bytes-per-step figure."""
+        if not self.paged:
+            return
+        bs = self.kv_block_size
+        blocks = sum(
+            -(-max(int(self._mirror_len[r]), 1) // bs) for r in rows
+        )
+        if blocks:
+            ATTN_BLOCKS_READ.inc(blocks * steps)
 
     # ------------------------------------------------------------------ API
 
@@ -1259,6 +1355,14 @@ class PipelineServer:
                     default_deadline_s=self.default_deadline_s,
                     kv_block_size=self.kv_block_size,
                     kv_blocks=self.kv_blocks,
+                    # the REQUESTED backend, not the resolved impl: an
+                    # operator's explicit kernel/xla pin survives restore
+                    # (snapshot-wins, like every serve kwarg), while
+                    # "auto" re-resolves against the restoring host's
+                    # backend — a snapshot taken on TPU still restores on
+                    # a CPU mesh (pre-PR-6 snapshots lack the key and
+                    # restore as "auto" via the constructor default)
+                    paged_attn=self.paged_attn,
                 ),
                 # block ownership travels with the checkpoint: restore
                 # rebuilds the allocator's free list/refcounts from the
@@ -1616,11 +1720,15 @@ class PipelineServer:
         chunk was driving fail, the daemon survives)."""
         t0 = time.perf_counter()
         cycles = self.num_stages * self.chunk_cycles
+        # the dispatched static, not attn_impl: dense servers compile the
+        # programs with attn="xla" (the arg is inert at block_size=0), and
+        # the shape key must name the variant the jit cache actually keys
+        attn = self.attn_impl if self.paged else "xla"
         record_shape_key(
             "serve_chunk",
             (self.num_stages, self.batch_per_slot, self.capacity,
              cycles, self._sampling, self._filtering, self.tp,
-             self.kv_block_size),
+             self.kv_block_size, attn),
         )
 
         def do_chunk():
@@ -1638,6 +1746,7 @@ class PipelineServer:
                 self._filtering,
                 tp=self.tp,
                 block_size=self.kv_block_size or 0,
+                attn=attn,
             )
 
         self._flush_tables()
@@ -1652,6 +1761,11 @@ class PipelineServer:
             ("chunk",
              self._prefetcher.fetch(log, tag=f"chunk m0={self._m}"),
              self._m)
+        )
+        self._record_blocks_read(
+            [i for i, r in enumerate(self._rows)
+             if r is not None and not r.done],
+            steps=self.chunk_cycles,
         )
         dt_dispatch = time.perf_counter() - t0
         _M_STEP_PHASE.labels(phase="dispatch").observe(dt_dispatch)
@@ -2944,10 +3058,12 @@ class PipelineServer:
                 draft[i, : d.shape[0]] = d
                 draft_len[i] = d.shape[0]
                 cache_delta[i] = self._mirror_cachedelta[row]
+            # the dispatched static, not attn_impl (see _dispatch_chunk)
+            attn = self.attn_impl if self.paged else "xla"
             record_shape_key(
                 "serve_verify",
                 (self.num_stages, Bs, self.capacity, K, self._sampling,
-                 self._filtering, self.tp, self.kv_block_size),
+                 self._filtering, self.tp, self.kv_block_size, attn),
             )
             def do_verify(slot=slot, draft=draft, draft_len=draft_len,
                           cache_delta=cache_delta):
@@ -2969,6 +3085,7 @@ class PipelineServer:
                     self._filtering,
                     tp=self.tp,
                     block_size=self.kv_block_size or 0,
+                    attn=attn,
                 )
 
             self._flush_tables()
@@ -2991,6 +3108,7 @@ class PipelineServer:
                     ],
                 )
             )
+            self._record_blocks_read([row for row, _ in live])
             self.counters.inc("chunks")
 
     def _apply_spec(self, log: np.ndarray, entries: list) -> None:

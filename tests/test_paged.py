@@ -498,6 +498,38 @@ def test_paged_attention_xla_matches_dense():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
 
 
+def test_write_block_kv_scatters_into_owning_blocks():
+    """The decode-path write primitive: entries land in the block the
+    table names at the in-block slot, trash-mapped columns hit the sink,
+    untouched slots are untouched, and the ``valid`` gate (ring-inactive
+    microsteps, masked layers) makes the write a no-op per entry."""
+    from llm_sharding_tpu.ops.paged_attention import write_block_kv
+
+    rng = np.random.default_rng(3)
+    NB, bs, Nkv, D = 6, 4, 2, 8
+    B = 3
+    k = jnp.asarray(rng.normal(size=(NB, bs, Nkv, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(NB, bs, Nkv, D)), jnp.float32)
+    tbl = jnp.asarray([[2, 3, 0], [4, 0, 0], [5, 1, 0]], jnp.int32)
+    cols = jnp.asarray([[5], [2], [9]], jnp.int32)  # row 2 → trash (entry 0)
+    kn = jnp.asarray(rng.normal(size=(B, 1, Nkv, D)), jnp.float32)
+    vn = jnp.asarray(rng.normal(size=(B, 1, Nkv, D)), jnp.float32)
+    k2, v2 = write_block_kv(k, v, tbl, cols, kn, vn)
+    np.testing.assert_array_equal(np.asarray(k2)[3, 1], np.asarray(kn)[0, 0])
+    np.testing.assert_array_equal(np.asarray(v2)[4, 2], np.asarray(vn)[1, 0])
+    np.testing.assert_array_equal(np.asarray(k2)[0, 1], np.asarray(kn)[2, 0])
+    np.testing.assert_array_equal(np.asarray(k2)[5], np.asarray(k)[5])
+    # per-entry valid gating: only row 1 writes
+    mask = jnp.asarray([[False], [True], [False]])
+    k3, _ = write_block_kv(k, v, tbl, cols, kn, vn, valid=mask)
+    np.testing.assert_array_equal(np.asarray(k3)[3, 1], np.asarray(k)[3, 1])
+    np.testing.assert_array_equal(np.asarray(k3)[4, 2], np.asarray(kn)[1, 0])
+    # scalar False (an inactive ring microstep) is a global no-op
+    k4, v4 = write_block_kv(k, v, tbl, cols, kn, vn, valid=jnp.asarray(False))
+    np.testing.assert_array_equal(np.asarray(k4), np.asarray(k))
+    np.testing.assert_array_equal(np.asarray(v4), np.asarray(v))
+
+
 def test_paged_attention_pallas_interpret_matches_xla():
     """The Pallas TPU kernel (interpret mode on CPU) == the XLA gather
     path: same online-softmax result over trash-padded ragged windows."""
@@ -530,3 +562,143 @@ def test_paged_attention_pallas_interpret_matches_xla():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=2e-6
     )
+
+
+def test_paged_attention_pallas_interpret_multiquery_matches_xla():
+    """S > 1 queries per row — the serve_verify shape (K+1 draft
+    positions): the kernel's GQA fold tiles the positions across the
+    grouped query rows and the causal mask stays per-position."""
+    from llm_sharding_tpu.models.cache import POS_SENTINEL
+    from llm_sharding_tpu.ops.paged_attention import (
+        paged_attention_tpu, paged_attention_xla,
+    )
+
+    rng = np.random.default_rng(17)
+    B, S, T, bs, Nkv, G, D = 2, 3, 3, 8, 2, 2, 16
+    W, Nh = T * bs, Nkv * G
+    NB = 8
+    k_arena = jnp.asarray(rng.normal(size=(NB, bs, Nkv, D)), jnp.float32)
+    v_arena = jnp.asarray(rng.normal(size=(NB, bs, Nkv, D)), jnp.float32)
+    tbl = np.array([[3, 5, 0], [7, 2, 0]], np.int32)
+    lengths = [bs + 5, 11]  # committed prefix per row
+    kvpos = np.full((B, W), POS_SENTINEL, np.int32)
+    for b in range(B):
+        # prefix + the S in-flight verify positions
+        kvpos[b, : lengths[b] + S] = np.arange(lengths[b] + S)
+    q = jnp.asarray(rng.normal(size=(B, S, Nh, D)), jnp.float32)
+    qpos = jnp.asarray(
+        [[lengths[b] + i for i in range(S)] for b in range(B)], jnp.int32
+    )
+
+    want = paged_attention_xla(
+        q, k_arena, v_arena, jnp.asarray(tbl), qpos, jnp.asarray(kvpos)
+    )
+    got = paged_attention_tpu(
+        q, k_arena, v_arena, jnp.asarray(tbl), qpos, jnp.asarray(kvpos),
+        interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-6
+    )
+
+
+# ------------------------------------------------- kernel serve-path wiring
+
+
+def test_paged_attn_kwarg_validation(setup):
+    _, eng = setup
+    with pytest.raises(ValueError, match="auto, kernel or xla"):
+        eng.serve(capacity=64, paged_attn="pallas", **paged_kw())
+    with pytest.raises(ValueError, match="only meaningful"):
+        eng.serve(capacity=64, paged_attn="xla")  # dense server
+    # explicit kernel on the CPU mesh: curated, at construction
+    with pytest.raises(ValueError, match="TPU backend"):
+        eng.serve(capacity=64, paged_attn="kernel", **paged_kw())
+
+
+def test_forced_backend_env_validation(monkeypatch):
+    from llm_sharding_tpu.ops.paged_attention import forced_backend
+
+    monkeypatch.delenv("PAGED_FORCE_KERNEL", raising=False)
+    assert forced_backend() is None
+    monkeypatch.setenv("PAGED_FORCE_KERNEL", "1")
+    assert forced_backend() == "kernel"
+    monkeypatch.setenv("PAGED_FORCE_KERNEL", "interpret")
+    assert forced_backend() == "interpret"
+    monkeypatch.setenv("PAGED_FORCE_KERNEL", "maybe")
+    with pytest.raises(ValueError, match="PAGED_FORCE_KERNEL"):
+        forced_backend()
+
+
+def test_op_level_forced_kernel_off_tpu_is_curated(monkeypatch):
+    """A lingering PAGED_FORCE_KERNEL=kernel reaching backend='auto' on a
+    CPU host must raise the curated op-level error, not a raw
+    Pallas/Mosaic lowering failure (the serve path curates this at
+    construction; the standalone op must too)."""
+    from llm_sharding_tpu.ops.paged_attention import paged_attention
+
+    k = jnp.zeros((2, 8, 1, 128), jnp.float32)
+    tbl = jnp.ones((1, 2), jnp.int32)
+    q = jnp.zeros((1, 1, 1, 128), jnp.float32)
+    qpos = jnp.zeros((1, 1), jnp.int32)
+    kvpos = jnp.zeros((1, 16), jnp.int32)
+    monkeypatch.setenv("PAGED_FORCE_KERNEL", "kernel")
+    with pytest.raises(ValueError, match="TPU backend"):
+        paged_attention(q, k, k, tbl, qpos, kvpos, backend="auto")
+    with pytest.raises(ValueError, match="TPU backend"):
+        paged_attention(q, k, k, tbl, qpos, kvpos, backend="kernel")
+
+
+def test_kernel_serve_path_interpret_token_identical(setup, monkeypatch):
+    """The tentpole contract, pinned independently of the CI env: with the
+    kernel forced into interpret mode, the serve programs decode through
+    the Pallas code path — direct block-indexed writes, streamed-block
+    attention, NO gathered window — and greedy output still equals dense
+    serving and the solo oracle. Covers plain decode AND spec-verify's
+    canonical-column scatter (rollback = position rewind)."""
+    params, eng = setup
+    specs = [
+        (prompt(71, 5), 9, {}), (prompt(72, 3), 6, {}),
+        (prompt(73, 6), 4, {}),
+    ]
+    dense = run_workload(eng.serve(capacity=64), specs)
+    dense_spec = run_workload(eng.serve(capacity=64, speculate=2), specs)
+    assert dense_spec == dense
+
+    monkeypatch.setenv("PAGED_FORCE_KERNEL", "interpret")
+    srv = eng.serve(capacity=64, **paged_kw())
+    assert srv.attn_impl == "interpret"
+    assert run_workload(srv, specs) == dense
+    check_drained(srv)
+    srv_spec = eng.serve(capacity=64, speculate=2, **paged_kw())
+    assert srv_spec.attn_impl == "interpret"
+    assert run_workload(srv_spec, specs) == dense
+    check_drained(srv_spec)
+    for (p, b, _), toks in zip(specs, dense):
+        assert toks == oracle_tokens(params, p, b)
+
+
+def test_attn_backend_metrics(setup, monkeypatch):
+    """server_attn_backend reflects each live server's resolved
+    implementation and server_attn_blocks_read_total grows as paged
+    decode steps attend mapped blocks (the bench's bytes-estimate feed)."""
+    from llm_sharding_tpu.obs.metrics import ATTN_BACKEND, ATTN_BLOCKS_READ
+    from llm_sharding_tpu.runtime.server import _update_load_gauges
+
+    _, eng = setup
+    monkeypatch.delenv("PAGED_FORCE_KERNEL", raising=False)
+    srv = eng.serve(capacity=64, **paged_kw())
+    assert srv.attn_impl == "xla"  # CPU mesh resolves auto → gather
+    _update_load_gauges()
+    assert ATTN_BACKEND.labels(backend="xla").value >= 1
+    before = ATTN_BLOCKS_READ.value
+    r = srv.submit(prompt(74), 8)
+    srv.run_until_idle()
+    assert r.done and ATTN_BLOCKS_READ.value > before
+    check_drained(srv)
+    # a closed server must drop out of the tally even while referenced
+    # (the one-hot contract across e.g. a :placement rebuild)
+    xla_live = ATTN_BACKEND.labels(backend="xla").value
+    srv.close()
+    _update_load_gauges()
+    assert ATTN_BACKEND.labels(backend="xla").value == xla_live - 1

@@ -384,3 +384,23 @@ def test_cancel_before_deferred_admit_token_applies(setup):
     assert rq.tokens == [], "phantom token applied after cancel"
     c = srv.counters
     assert c.requests_cancelled == 1 and c.requests_completed == 0
+
+
+def test_dense_server_ignores_kernel_env_and_rejects_paged_attn(
+    setup, monkeypatch
+):
+    """PAGED_FORCE_KERNEL only steers PAGED attention: a dense server
+    resolves to the 'dense' impl regardless of the env (its decode has no
+    block tables to stream) and an explicit paged_attn on a dense server
+    is a curated error, mirroring the CLI's fast-fail."""
+    params, eng = setup
+    monkeypatch.setenv("PAGED_FORCE_KERNEL", "interpret")
+    srv = eng.serve(capacity=64)
+    assert srv.attn_impl == "dense"
+    rng = np.random.default_rng(31)
+    p = rng.integers(1, CFG.vocab_size, 4).astype(np.int32)
+    r = srv.submit(p, 6)
+    srv.run_until_idle()
+    assert r.tokens == oracle_tokens(params, p, 6)
+    with pytest.raises(ValueError, match="only meaningful"):
+        eng.serve(capacity=64, paged_attn="kernel")

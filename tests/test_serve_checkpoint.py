@@ -458,3 +458,22 @@ def test_extract_adopt_chunked_admission_rng_carry(two_servers):
     assert dst.result(r) == oracle(params, p, 12, temperature=0.9, seed=4)
     src.close()
     dst.close()
+
+
+def test_snapshot_carries_paged_attn_pin(setup):
+    """An operator's explicit attention-backend pin survives restore like
+    every other serve kwarg (snapshot-wins): a paged_attn='xla' daemon
+    restores as 'xla', not back to 'auto' — which on a TPU host would
+    silently re-enable the kernel the operator pinned away from. Pre-PR-6
+    snapshots lack the key and restore as 'auto' via the default."""
+    _, eng = setup
+    srv = eng.serve(capacity=64, kv_block_size=16, kv_blocks=24,
+                    paged_attn="xla")
+    snap = srv.snapshot()
+    assert snap["serve_kwargs"]["paged_attn"] == "xla"
+    srv2 = PipelineServer.restore(eng, snap)
+    assert srv2.paged_attn == "xla" and srv2.attn_impl == "xla"
+    # legacy snapshot without the key: constructor default applies
+    del snap["serve_kwargs"]["paged_attn"]
+    srv3 = PipelineServer.restore(eng, snap)
+    assert srv3.paged_attn == "auto"

@@ -443,3 +443,29 @@ def test_spec_metrics_move(setup):
     assert r.tokens == oracle(params, p, 12)
     assert M_SPEC_DRAFTED.value > d0
     assert M_SPEC_ACCEPTED.value >= a0
+
+
+def test_server_spec_paged_kernel_interpret_exact(setup, monkeypatch):
+    """Speculative verify through the Pallas kernel path (interpret on
+    CPU), batched slot rows: the K+1 in-flight entries scatter straight
+    into their canonical arena columns during the traversal and rollback
+    is a pure position rewind — output must still equal the solo oracle
+    (and therefore the dense spec server, pinned above)."""
+    params, eng = setup
+    monkeypatch.setenv("PAGED_FORCE_KERNEL", "interpret")
+    srv = eng.serve(
+        capacity=64, batch_per_slot=2, speculate=3,
+        kv_block_size=16, kv_blocks=8 * 64 // 16 + 1,
+    )
+    assert srv.attn_impl == "interpret"
+    rng = np.random.default_rng(23)
+    prompts = [
+        np.tile(rng.integers(1, CFG.vocab_size, 3).astype(np.int32), 3)
+        for _ in range(4)
+    ]
+    reqs = [srv.submit(p, 10) for p in prompts]
+    srv.run_until_idle()
+    for p, r in zip(prompts, reqs):
+        assert r.error is None and r.tokens == oracle(params, p, 10)
+    srv._alloc.check()
+    assert srv._alloc.in_use == 0
