@@ -492,6 +492,196 @@ def bench_fault_serve(on_tpu, engine):
     )
 
 
+def bench_overload_serve(on_tpu, engine):
+    """ISSUE 9: goodput + p99 TTFT at 2x sustained overload vs at
+    capacity, through the HTTP ingress. The front door must shed the
+    overflow EARLY (typed 429/503 + Retry-After — asserted in-band via
+    ``server_rejected_total`` and the absence of any queue-timeout 504)
+    while the accepted requests' token output stays IDENTICAL to an
+    unloaded run — overload costs the excess traffic, never correctness
+    or the admitted requests' throughput."""
+    import http.client
+    import threading
+
+    from llm_sharding_tpu.obs.metrics import REGISTRY
+    from llm_sharding_tpu.runtime.ingress import IngressServer
+
+    name = (
+        "serve_overload_goodput_llama3.2-3b_1stage" if on_tpu
+        else "serve_overload_goodput_tiny_cpu"
+    )
+    if on_tpu:
+        batch_per_slot, capacity = 8, 320
+        prompt_len, max_new, n_cap, n_over = 32, 64, 24, 48
+    else:
+        batch_per_slot, capacity = 2, 64
+        prompt_len, max_new, n_cap, n_over = 8, 16, 6, 12
+    cfg = engine.cfg
+    rng = np.random.default_rng(23)
+    # the overload phase re-offers the SAME prompt set twice over, so every
+    # accepted completion has an unloaded reference to be compared against
+    prompts = [
+        rng.integers(0, cfg.vocab_size, prompt_len).astype(np.int32)
+        for _ in range(n_cap)
+    ]
+
+    def post(port, i, headers=None, timeout=600.0):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+        try:
+            conn.request(
+                "POST", "/v1/completions",
+                json.dumps({
+                    "prompt": [int(t) for t in prompts[i % n_cap]],
+                    "max_tokens": max_new, "stream": True,
+                }),
+                {"Content-Type": "application/json", **(headers or {})},
+            )
+            resp = conn.getresponse()
+            if resp.status != 200:
+                body = resp.read()
+                return resp.status, None, None, (
+                    resp.getheader("Retry-After"), body[:200]
+                )
+            ttft = None
+            t0 = time.perf_counter()
+            toks = []
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line or not line.startswith(b"data: "):
+                    continue
+                payload = line[len(b"data: "):]
+                if payload == b"[DONE]":
+                    break
+                ev = json.loads(payload)
+                ids = ev["choices"][0]["token_ids"]
+                if ids and ttft is None:
+                    ttft = time.perf_counter() - t0
+                toks.extend(ids)
+            return 200, toks, ttft, None
+        finally:
+            conn.close()
+
+    def phase(n_requests, concurrency, tenants=None, headers=None):
+        srv = engine.serve(capacity=capacity, batch_per_slot=batch_per_slot)
+        ing = IngressServer(
+            srv, tenants=tenants,
+            allow_anonymous=tenants is None,
+            poll_interval_s=0.0005,
+        )
+        port = ing.start()
+        results = [None] * n_requests
+        lock = threading.Lock()
+        idx = [0]
+
+        def worker():
+            while True:
+                with lock:
+                    if idx[0] >= n_requests:
+                        return
+                    i = idx[0]
+                    idx[0] += 1
+                results[i] = post(port, i, headers)
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=worker) for _ in range(concurrency)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        ing.stop()
+        srv.close()
+        del srv
+        gc.collect()
+        return results, dt
+
+    rows = engine.mesh.shape["pipe"] * batch_per_slot
+
+    # unloaded reference: one request at a time, nothing can shed
+    unloaded, _ = phase(n_cap, 1)
+    expected = {i: r[1] for i, r in enumerate(unloaded)}
+    if any(r[0] != 200 for r in unloaded):
+        raise RuntimeError(f"unloaded run saw rejections: {unloaded}")
+
+    # at capacity: enough concurrency to keep every row busy, no overflow
+    rej_fam = REGISTRY.get("server_rejected_total")
+
+    def rejected_total():
+        return sum(c.value for _, c in rej_fam.series())
+
+    cap_results, cap_dt = phase(n_cap, rows)
+    cap_tokens = sum(len(r[1]) for r in cap_results if r[0] == 200)
+    cap_ttfts = sorted(r[2] for r in cap_results if r[0] == 200)
+    goodput_cap = cap_tokens / cap_dt
+
+    # 2x overload: double the offered work at double the concurrency
+    # against a token bucket sized to admit exactly the at-capacity load —
+    # the overflow MUST shed early and typed (a burst-timing-dependent
+    # queue cap would make the shed count non-deterministic; the bucket
+    # makes it exact: n_cap admitted, n_over - n_cap shed with 429)
+    from llm_sharding_tpu.runtime.fairness import TenantConfig
+
+    rej0 = rejected_total()
+    over_results, over_dt = phase(
+        n_over, 2 * rows,
+        tenants=[TenantConfig("bench", rate_rps=1e-6, burst=float(n_cap))],
+        headers={"X-Tenant": "bench"},
+    )
+    rejected = int(rejected_total() - rej0)
+    statuses = [r[0] for r in over_results]
+    bad = [s for s in statuses if s not in (200, 429, 503)]
+    if bad:
+        # a 504 here means a request died of queue timeout instead of
+        # being shed at the door — exactly what the ingress must prevent
+        raise RuntimeError(f"overload produced non-shed failures: {statuses}")
+    shed = sum(1 for s in statuses if s in (429, 503))
+    if shed == 0:
+        raise RuntimeError(
+            "2x overload shed nothing — the bounded ingress queue did not "
+            "engage; the scenario is not measuring overload"
+        )
+    if rejected < shed:
+        raise RuntimeError(
+            f"server_rejected_total moved by {rejected} but {shed} "
+            "requests were shed — rejections are not early-shed-typed"
+        )
+    mismatch = [
+        i for i, r in enumerate(over_results)
+        if r[0] == 200 and r[1] != expected[i % n_cap]
+    ]
+    # accepted requests must be token-identical to the unloaded run
+    token_identical = not mismatch and all(
+        r[1] == expected[i] for i, r in enumerate(cap_results)
+        if r[0] == 200
+    )
+    if not token_identical:
+        raise RuntimeError(
+            f"accepted-request tokens diverged from the unloaded run "
+            f"(overload mismatches at {mismatch})"
+        )
+    over_tokens = sum(len(r[1]) for r in over_results if r[0] == 200)
+    over_ttfts = sorted(r[2] for r in over_results if r[0] == 200)
+    goodput_over = over_tokens / over_dt
+
+    def p99(xs):
+        return xs[min(int(0.99 * len(xs)), len(xs) - 1)] if xs else 0.0
+
+    emit(
+        name, goodput_over, "tokens/sec", goodput_over / ANCHOR_TOK_S,
+        goodput_at_capacity=round(goodput_cap, 2),
+        goodput_frac=round(goodput_over / max(goodput_cap, 1e-9), 3),
+        p99_ttft_ms_capacity=round(p99(cap_ttfts) * 1e3, 1),
+        p99_ttft_ms_overload=round(p99(over_ttfts) * 1e3, 1),
+        offered=n_over, accepted=statuses.count(200), shed=shed,
+        rejections_typed=True, token_identical=True,
+    )
+
+
 def bench_failover_serve(on_tpu, cfg, params, jax, jnp):
     """Throughput DURING a replica failover vs the clean dp run. A seeded
     ``replica_step`` fault kills replica 0 mid-decode; the supervision
@@ -1062,6 +1252,10 @@ def main():
         "serve_tok_s_paged_kernel_llama3.2-3b_1stage" if on_tpu
         else "serve_tok_s_paged_kernel_tiny_cpu"
     )
+    noverload = (
+        "serve_overload_goodput_llama3.2-3b_1stage" if on_tpu
+        else "serve_overload_goodput_tiny_cpu"
+    )
 
     # section order = survival priority under a driver-side timeout:
     # 3B (anchor emitted immediately) → serve → 3B-int8 → pallas → 7B(+int8)
@@ -1141,6 +1335,18 @@ def main():
                 bench_fault_serve(on_tpu, serve_engine)
             except Exception as e:  # noqa: BLE001
                 emit_error(nfault, "tokens/sec", e)
+        # overload goodput (the HTTP ingress's early-shed story) reuses
+        # the serve engine too
+        if serve_engine is None:
+            emit_error(noverload, "tokens/sec",
+                       "not attempted: serve engine unavailable")
+        elif remaining() < 120:
+            emit_skip(noverload, "tokens/sec", 120)
+        else:
+            try:
+                bench_overload_serve(on_tpu, serve_engine)
+            except Exception as e:  # noqa: BLE001
+                emit_error(noverload, "tokens/sec", e)
         # replica failover (dp2 supervision: kill one replica mid-decode,
         # throughput through migration vs clean) builds its OWN replica
         # engines from params3b — run before int8 donates those buffers
@@ -1212,6 +1418,8 @@ def main():
             gc.collect()
     else:
         emit_error(nserve, "tokens/sec", "not attempted: 3B section failed")
+        emit_error(noverload, "tokens/sec",
+                   "not attempted: 3B section failed")
         emit_error(npaged, "tokens/sec", "not attempted: 3B section failed")
         emit_error(nfailover, "tokens/sec",
                    "not attempted: 3B section failed")

@@ -31,9 +31,46 @@ import argparse
 import json
 import logging
 import os
+import signal
 import sys
+import threading
+import time
 
 import numpy as np
+
+
+def _stdin_lines(stop_evt):
+    """Prompt lines from stdin, waking every 200 ms to honor a SIGTERM
+    (``stop_evt``) even while blocked waiting for input. Falls back to
+    plain iteration when stdin is not selectable (tests monkeypatch a
+    ``StringIO``; pipes and TTYs take the select path).
+
+    The select path reads the fd RAW (``os.read``) and splits lines
+    itself: mixing ``select()`` with buffered ``sys.stdin.readline()``
+    strands any second line of a burst in Python's read-ahead buffer,
+    where select — which only sees the OS pipe — never reports it."""
+    try:
+        fd = sys.stdin.fileno()
+        import select as _select
+
+        _select.select([fd], [], [], 0)
+    except Exception:  # noqa: BLE001 — no real fd / select unsupported
+        yield from sys.stdin
+        return
+    buf = ""
+    while not stop_evt.is_set():
+        r, _, _ = _select.select([fd], [], [], 0.2)
+        if not r:
+            continue
+        chunk = os.read(fd, 65536)
+        if not chunk:  # EOF (^D / closed pipe)
+            if buf:
+                yield buf
+            return
+        buf += chunk.decode("utf-8", errors="replace")
+        while "\n" in buf:
+            line, buf = buf.split("\n", 1)
+            yield line + "\n"
 
 
 def _dtype(name: str):
@@ -378,6 +415,34 @@ def cmd_serve(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if getattr(args, "tenants_config", None) and not getattr(
+        args, "http_port", 0
+    ):
+        print(
+            "error: --tenants-config needs --http-port (tenant policy is "
+            "enforced at the HTTP ingress; stdin prompts have no tenant)",
+            file=sys.stderr,
+        )
+        return 2
+    if getattr(args, "autoscale", False) and getattr(
+        args, "data_parallel", 1
+    ) < 2:
+        print(
+            "error: --autoscale needs --data-parallel >= 2 (the autoscaler "
+            "drives ReplicatedServer drain/spawn between --min-replicas "
+            "and the replica count)",
+            file=sys.stderr,
+        )
+        return 2
+    if getattr(args, "tenants_config", None):
+        # fail a malformed tenants file in milliseconds, not after model load
+        from .runtime.fairness import load_tenants_config
+
+        try:
+            load_tenants_config(args.tenants_config)
+        except (OSError, ValueError, TypeError, KeyError) as e:
+            print(f"error: bad --tenants-config: {e}", file=sys.stderr)
+            return 2
     if getattr(args, "data_parallel", 1) > 1:
         # data-parallel daemon: D replica servers over disjoint device
         # groups behind a router (runtime/replicated.py). :placement is a
@@ -527,6 +592,9 @@ def cmd_serve(args) -> int:
             f":placement <ranges|N> re-shards live",
             file=sys.stderr,
         )
+    ingress = None
+    autoscaler = None
+    _term_evt = threading.Event()
     metrics_srv = _start_metrics(
         getattr(args, "metrics_port", 0),
         # late-bound: ``srv`` is rebound on :placement — the provider always
@@ -540,12 +608,85 @@ def cmd_serve(args) -> int:
             ),
         },
         # /healthz now answers from the LIVE state machine: 503 on
-        # DEGRADED/DRAINING so a load balancer rotates the daemon out
-        health=lambda: srv.health,
+        # DEGRADED/DRAINING (and on an ingress-level drain) so a load
+        # balancer rotates the daemon out
+        health=lambda: ingress.health if ingress is not None else srv.health,
     )
-    tok = eng._require_tokenizer()
+    # a tokenizer-less store still serves: the HTTP ingress speaks token
+    # ids and stdin prompts get a per-line refusal instead of a dead daemon
+    try:
+        tok = eng._require_tokenizer()
+    except ValueError:
+        tok = None
+    # -- production ingress: HTTP/SSE front door + fairness + autoscale ----
+    if getattr(args, "http_port", 0):
+        from .runtime.ingress import start_ingress
+
+        ingress = start_ingress(
+            srv,
+            port=args.http_port,
+            tokenizer=tok,
+            tenants=getattr(args, "tenants_config", None),
+            max_queue=args.max_queue or None,
+            model_name=eng.cfg.model_type,
+            on_error=lambda msg: print(msg, file=sys.stderr),
+        )
+        if ingress is not None:
+            print(
+                f"ingress: http://127.0.0.1:{ingress.port}/v1/completions "
+                f"(tenants: {', '.join(ingress.fair.tenants())})",
+                file=sys.stderr,
+            )
+    if getattr(args, "autoscale", False):
+        from .runtime.autoscale import Autoscaler
+
+        autoscaler = Autoscaler(
+            srv,
+            min_replicas=getattr(args, "min_replicas", 1),
+            scale_up_load=getattr(args, "autoscale_up_load", 0.8),
+            scale_down_load=getattr(args, "autoscale_down_load", 0.3),
+            up_after_s=getattr(args, "autoscale_up_after", 1.0),
+            down_after_s=getattr(args, "autoscale_down_after", 5.0),
+            cooldown_s=getattr(args, "autoscale_cooldown", 3.0),
+            extra_load=(
+                (lambda: ingress.fair.depth()) if ingress is not None
+                else None
+            ),
+        )
+        if ingress is not None:
+            # the ingress ticks the controller from its sidecar thread,
+            # with the fair-queue backlog folded into the load signal
+            ingress.attach_autoscaler(autoscaler)
+        else:
+            # no HTTP front door: tick from a sidecar thread so the dp
+            # daemon still self-sizes under Python-API / stdin load
+            def _tick_forever():
+                while not _term_evt.is_set():
+                    try:
+                        autoscaler.tick()
+                    except Exception as e:  # noqa: BLE001 — policy errors
+                        # must never kill the daemon
+                        print(f"autoscale tick failed: {e}", file=sys.stderr)
+                    time.sleep(0.25)
+
+            threading.Thread(
+                target=_tick_forever, daemon=True, name="autoscale-tick"
+            ).start()
+        print(
+            f"autoscale: replicas in [{autoscaler.min_replicas}, "
+            f"{autoscaler.max_replicas}], up at load >= "
+            f"{autoscaler.scale_up_load:g}, down at <= "
+            f"{autoscaler.scale_down_load:g}",
+            file=sys.stderr,
+        )
+    # -- graceful SIGTERM: DRAINING -> finish in-flight -> exit 0 ----------
+    if threading.current_thread() is threading.main_thread():
+        try:
+            signal.signal(signal.SIGTERM, lambda *_: _term_evt.set())
+        except (ValueError, OSError):
+            pass  # embedded interpreter without signal support
     n_prompt = 0
-    for line in sys.stdin:
+    for line in _stdin_lines(_term_evt):
         prompt = line.rstrip("\n")
         if not prompt:
             continue
@@ -553,7 +694,30 @@ def cmd_serve(args) -> int:
             if getattr(args, "data_parallel", 1) > 1:
                 srv = _dp_serve_control(srv, prompt)
             else:
-                srv = _serve_control(eng, srv, prompt, args)
+                if ingress is not None:
+                    # freeze dispatch/stepping during the rebuild: the old
+                    # server is drained, re-sharded and closed — a pump
+                    # racing that would submit to (and step) a server
+                    # whose arrays are being swapped under it. Queued HTTP
+                    # requests simply wait out the maintenance window.
+                    ingress.pause()
+                try:
+                    srv = _serve_control(eng, srv, prompt, args)
+                finally:
+                    if ingress is not None:
+                        if ingress.backend is not srv:
+                            # the rebuild produced a new server — point
+                            # the front door at the live one
+                            ingress.backend = srv
+                        ingress.resume()
+            continue
+        if tok is None:
+            print(
+                "rejected: this store has no tokenizer — text prompts "
+                "need one (the HTTP ingress still accepts token-id "
+                "prompts)",
+                file=sys.stderr,
+            )
             continue
         ids = np.asarray(tok(prompt)["input_ids"], np.int32)
         # per-request seed advances from --seed so two identical sampled
@@ -584,7 +748,49 @@ def cmd_serve(args) -> int:
             # already streamed; name the cause and keep serving
             print(f"\n[request failed: {e.__cause__ or e}]", file=sys.stderr)
         print(flush=True)
+    if _term_evt.is_set():
+        # k8s-style rolling restart: SIGTERM means drain, not die. New
+        # work is shed with 503 (ingress DRAINING; /healthz pulls us from
+        # rotation), in-flight requests FINISH (the ingress pump keeps
+        # stepping its streams to completion), an armed snapshot dir gets
+        # a final checkpoint, and the exit code is 0 — no live stream is
+        # ever killed by a restart again.
+        print("SIGTERM: draining (new requests shed with 503)",
+              file=sys.stderr)
+        if ingress is not None:
+            ingress.begin_drain()
+        try:
+            srv.run_until_idle()  # finish in-flight requests
+        except Exception as e:  # noqa: BLE001 — drain anyway
+            print(f"drain pump failed: {e}", file=sys.stderr)
+        if ingress is not None and not ingress.wait_idle(
+            timeout_s=getattr(args, "drain_grace", 60.0)
+        ):
+            # report the truncation honestly instead of claiming a clean
+            # drain — the exit code stays 0 (k8s sends SIGKILL next
+            # anyway; dying mid-sentence loudly beats dying silently)
+            print(
+                "warning: drain grace expired with streams still live — "
+                "raise --drain-grace to let long completions finish",
+                file=sys.stderr,
+            )
+        if (
+            args.snapshot_dir and getattr(args, "data_parallel", 1) == 1
+            and hasattr(srv, "snapshot")
+        ):
+            try:
+                from .runtime.server import save_snapshot
+
+                save_snapshot(srv.snapshot(), args.snapshot_dir)
+                print(f"final snapshot written to {args.snapshot_dir}",
+                      file=sys.stderr)
+            except Exception as e:  # noqa: BLE001 — a failed final
+                # snapshot must not turn a graceful drain into rc != 0
+                print(f"final snapshot failed: {e}", file=sys.stderr)
+        print("drained; exiting 0", file=sys.stderr)
     print(json.dumps(srv.counters.snapshot()), file=sys.stderr)
+    if ingress is not None:
+        ingress.stop()
     if metrics_srv is not None:
         metrics_srv.stop()
     if hasattr(srv, "close"):
@@ -1021,6 +1227,68 @@ def build_parser() -> argparse.ArgumentParser:
         help="serve /metrics (Prometheus text) and /statz (JSON with "
         "p50/p90/p99 TTFT, queue-wait, inter-token latency) on "
         "127.0.0.1:PORT from a background thread (0 = off)",
+    )
+    s.add_argument(
+        "--http-port", type=int, default=0, dest="http_port",
+        help="production ingress: serve an OpenAI-compatible POST "
+        "/v1/completions (SSE streaming with \"stream\": true, "
+        "X-Deadline-Ms -> per-request deadline, request ids tied to the "
+        "trace spans) on 127.0.0.1:PORT, with per-tenant rate limits and "
+        "weighted fair queueing in front of admission (0 = off). Overload "
+        "is shed EARLY with typed 429/503 + Retry-After; a client "
+        "disconnect mid-stream cancels the row and frees its KV blocks",
+    )
+    s.add_argument(
+        "--tenants-config", default=None, dest="tenants_config",
+        help="JSON tenant policy for --http-port: {\"tenants\": {NAME: "
+        "{\"key\": BEARER, \"weight\": W, \"rate_rps\": R, \"burst\": B, "
+        "\"max_queued\": Q}}, \"allow_anonymous\": bool}. Without it every "
+        "request lands on one unlimited anonymous tenant",
+    )
+    s.add_argument(
+        "--autoscale", action="store_true",
+        help="with --data-parallel: drive ReplicatedServer drain/spawn "
+        "from the live load signal (backend queue + in-flight + ingress "
+        "backlog over live slots) with hysteresis, between --min-replicas "
+        "and the full replica count — the dp daemon self-sizes under a "
+        "diurnal load curve instead of being hand-drained",
+    )
+    s.add_argument(
+        "--autoscale-up-load", type=float, default=0.8,
+        dest="autoscale_up_load",
+        help="spawn a replica when the load signal holds at or above this "
+        "for the sustain window (default 0.8)",
+    )
+    s.add_argument(
+        "--autoscale-down-load", type=float, default=0.3,
+        dest="autoscale_down_load",
+        help="drain the least-loaded replica when the load signal holds "
+        "at or below this for the (longer) sustain window (default 0.3)",
+    )
+    s.add_argument(
+        "--drain-grace", type=float, default=60.0, dest="drain_grace",
+        help="seconds a SIGTERM drain waits for live HTTP streams to "
+        "finish before exiting (default 60; size it under the pod's "
+        "terminationGracePeriod)",
+    )
+    s.add_argument(
+        "--autoscale-up-after", type=float, default=1.0,
+        dest="autoscale_up_after",
+        help="seconds the high-load signal must SUSTAIN before a spawn "
+        "(default 1.0) — short, because under-capacity sheds user traffic",
+    )
+    s.add_argument(
+        "--autoscale-down-after", type=float, default=5.0,
+        dest="autoscale_down_after",
+        help="seconds the low-load signal must sustain before a drain "
+        "(default 5.0) — longer than the up window, because over-capacity "
+        "only wastes a device group",
+    )
+    s.add_argument(
+        "--autoscale-cooldown", type=float, default=3.0,
+        dest="autoscale_cooldown",
+        help="seconds after any scale action during which the autoscaler "
+        "only observes (default 3.0) — the churn guard",
     )
     s.add_argument(
         "--trace-path", default=None, dest="trace_path",

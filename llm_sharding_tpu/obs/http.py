@@ -29,6 +29,26 @@ from typing import Callable, Dict, Optional
 from .metrics import REGISTRY, Registry
 
 
+def write_ignoring_disconnect(wfile, data: bytes, flush: bool = False) -> bool:
+    """Write a response body tolerating the client vanishing mid-write.
+
+    A scraper that times out, a load balancer health probe that closes
+    early, an SSE consumer that navigates away — all surface here as
+    ``BrokenPipeError``/``ConnectionResetError`` (or a bare ``OSError``
+    from a half-torn socket). That is NORMAL traffic at an exposition
+    endpoint, not an error: swallow it and report False instead of
+    splattering a handler-thread traceback per disconnect. ``flush=True``
+    additionally flushes (SSE streaming needs each event on the wire
+    now), under the same policy."""
+    try:
+        wfile.write(data)
+        if flush:
+            wfile.flush()
+        return True
+    except (BrokenPipeError, ConnectionResetError, OSError):
+        return False
+
+
 class MetricsServer:
     def __init__(
         self,
@@ -125,11 +145,22 @@ class MetricsServer:
                 else:
                     self.send_error(404, "try /metrics, /statz or /healthz")
                     return
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    return  # client left before the headers went out
+                write_ignoring_disconnect(self.wfile, body)
+
+            def handle_one_request(self):
+                # the request LINE read can also hit a reset socket; same
+                # policy as the body write — a disconnect is not an error
+                try:
+                    super().handle_one_request()
+                except (BrokenPipeError, ConnectionResetError):
+                    self.close_connection = True
 
             def log_message(self, *a):  # silence per-request stderr spam
                 pass

@@ -52,6 +52,15 @@ SITES = (
     #                    replica's device-group index) — a permanent fault
     #                    here simulates the whole replica vanishing and
     #                    drives the ReplicatedServer failover path
+    "http_request",    # one HTTP request entering the ingress (keyed by
+    #                    tenant name) — a fault here is infrastructure
+    #                    trouble at the front door; the ingress answers
+    #                    503 + Retry-After instead of crashing the handler
+    "slow_client",     # one SSE write to a streaming client (keyed by
+    #                    tenant name) — a fault here simulates the client
+    #                    stalling/vanishing mid-stream; the ingress must
+    #                    cancel the row and free its KV blocks exactly
+    #                    like a real BrokenPipeError
 )
 
 

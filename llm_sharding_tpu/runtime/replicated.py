@@ -582,6 +582,16 @@ class ReplicatedServer:
             )
             return moved
 
+    def least_loaded_group(self) -> Optional[int]:
+        """Device-group index of the live replica with the least work
+        (queued + in-flight) — the autoscaler's drain target, chosen so a
+        scale-down migrates the fewest streams. None with no live replica."""
+        with self._lock:
+            if not self.servers:
+                return None
+            s = min(self.servers, key=self._load)
+            return self._group_of.get(s)
+
     def spawn_replica(self) -> PipelineServer:
         """Elective scale-up: bring a fresh replica up on the lowest freed
         device group (weights re-staged from the host arrays the router

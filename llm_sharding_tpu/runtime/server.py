@@ -643,6 +643,12 @@ class Request:
         #           ``tokens``)
         "carried_rng",  # [2] uint32 sampling chain a migration carried in;
         #           consumed (installed on device) at the next admission
+        "tenant",  # ingress metadata: which tenant submitted this request
+        #           (None for direct API/CLI submits). The server itself
+        #           never schedules on it — fairness is enforced BEFORE
+        #           admission (runtime/fairness.py) — but it rides the
+        #           request through migration/snapshot so traces and logs
+        #           stay attributable
         "__weakref__",  # the dp router tracks request→replica ownership
     )
 
@@ -659,6 +665,7 @@ class Request:
         embeds: Optional[np.ndarray] = None,  # [S, H] privacy entry
         prefix: Optional["PrefixHandle"] = None,  # shared-prefix KV handle
         deadline_s: Optional[float] = None,  # relative deadline at submit
+        tenant: Optional[str] = None,  # ingress tenant metadata
     ):
         self.id = rid
         self.prompt = prompt
@@ -681,6 +688,7 @@ class Request:
         self.error: Optional[BaseException] = None
         self.baked = 0
         self.carried_rng: Optional[np.ndarray] = None
+        self.tenant = tenant
         self.submitted_at = time.perf_counter()
         self.deadline_at = (
             None if deadline_s is None else self.submitted_at + deadline_s
@@ -1141,6 +1149,7 @@ class PipelineServer:
         stop=None,  # iterable of stop STRINGS (host-side, needs a tokenizer)
         prefix: Optional[PrefixHandle] = None,
         deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> Request:
         """Enqueue a request (≙ ``receive_user_request``, admission happens
         on the next ``step``). ``temperature > 0`` samples with this
@@ -1188,6 +1197,7 @@ class PipelineServer:
                 self._new_id(), prompt, max_new_tokens,
                 temperature=temperature, seed=seed, top_k=top_k, top_p=top_p,
                 stop=stop, prefix=prefix, deadline_s=deadline_s,
+                tenant=tenant,
             )
             if self.speculate:
                 from .spec import AdaptiveK
@@ -1319,6 +1329,7 @@ class PipelineServer:
                     # migration bookkeeping: tokens already folded into the
                     # prompt, and a not-yet-consumed carried sampling chain
                     "baked": r.baked,
+                    "tenant": r.tenant,
                     "carried_rng": (
                         None if r.carried_rng is None
                         else [int(x) for x in r.carried_rng]
@@ -1489,6 +1500,7 @@ class PipelineServer:
             r.row = d["row"]
             # .get(): format-1/2 snapshots predate migration bookkeeping
             r.baked = int(d.get("baked", 0) or 0)
+            r.tenant = d.get("tenant")  # pre-ingress snapshots lack it
             cr = d.get("carried_rng")
             r.carried_rng = None if cr is None else np.asarray(cr, np.uint32)
             if d.get("deadline_left") is not None:
@@ -1566,6 +1578,7 @@ class PipelineServer:
         top_p: Optional[float] = None,
         stop=None,
         deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> Request:
         """Enqueue a request that enters as EMBEDDINGS — the privacy entry
         (≙ the reference's request-injection channel: an embedding-capable
@@ -1601,7 +1614,7 @@ class PipelineServer:
             req = Request(
                 self._new_id(), np.zeros((0,), np.int32), max_new_tokens,
                 temperature=temperature, seed=seed, top_k=top_k, top_p=top_p,
-                stop=stop, embeds=h, deadline_s=deadline_s,
+                stop=stop, embeds=h, deadline_s=deadline_s, tenant=tenant,
             )
             if self.speculate:
                 from .spec import AdaptiveK
@@ -3260,11 +3273,18 @@ class PipelineServer:
             _M_REQUEST.observe(req.finished_at - req.submitted_at)
             _M_TOK_S.observe(tok_s)
             if self._trace:
-                self._trace.emit(
-                    "request", dur_s=req.finished_at - req.submitted_at,
+                span = dict(
                     id=req.id, tokens=ntok,
                     queue_wait_s=round(queue_wait, 6),
                     ttft_s=round(ttft, 6), tok_s=round(tok_s, 2),
+                )
+                if req.tenant is not None:
+                    # ingress traffic: the span stays attributable to its
+                    # tenant (the HTTP response id carries the same req id)
+                    span["tenant"] = req.tenant
+                self._trace.emit(
+                    "request", dur_s=req.finished_at - req.submitted_at,
+                    **span,
                 )
             logger.info(
                 "complete id=%d tokens=%d duration=%.3fs queue_wait=%.3fs "

@@ -1,0 +1,958 @@
+"""Production ingress (ISSUE 9): the overload-safe HTTP/SSE front door —
+OpenAI-compatible completions over the live serving stack, per-tenant
+token-bucket rate limits + weighted fair queueing in front of admission,
+typed early shedding (429/503 + Retry-After, 504 for expired deadlines —
+never a queue-timeout death), disconnect hygiene (an abandoned stream's
+row cancels and its KV blocks free), and load-driven autoscaling with
+hysteresis driving ``ReplicatedServer`` drain/spawn.
+
+``INGRESS_TEST_DP`` (default 1) selects the backend: 1 = a single paged
+``PipelineServer``, >= 2 = a ``ReplicatedServer`` of that many replicas —
+tier-1 CI reruns the module at dp2 so the fairness and dispatch paths are
+exercised through the supervised router (owner re-resolution, per-replica
+allocators), not just a single server. The end-to-end flood/autoscale
+acceptance test always builds its own dp2 router.
+"""
+
+import http.client
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from llm_sharding_tpu.models import llama
+from llm_sharding_tpu.models.config import tiny_llama
+from llm_sharding_tpu.obs.metrics import REGISTRY
+from llm_sharding_tpu.runtime.autoscale import Autoscaler
+from llm_sharding_tpu.runtime.engine import PipelineEngine
+from llm_sharding_tpu.runtime.fairness import (
+    FairQueue, GlobalQueueFull, RateLimited, TenantConfig, TenantQueueFull,
+    TokenBucket, UnknownTenant, load_tenants_config,
+)
+from llm_sharding_tpu.runtime.generate import generate
+from llm_sharding_tpu.runtime.ingress import IngressServer
+from llm_sharding_tpu.runtime.replicated import ReplicatedServer
+
+CFG = tiny_llama(num_hidden_layers=8)
+DP = int(os.environ.get("INGRESS_TEST_DP", "1"))
+STAGES = 2
+CAP = 64
+KV = dict(kv_block_size=4, kv_blocks=48 * max(DP, 1))
+
+
+def counter_value(name, **labels):
+    fam = REGISTRY.get(name)
+    if fam is None:
+        return 0.0
+    if labels:
+        return fam.labels(**labels).value
+    return fam.value
+
+
+def prompt(seed, n=5):
+    return np.random.default_rng(seed).integers(
+        1, CFG.vocab_size, n
+    ).astype(np.int32)
+
+
+def oracle(params, p, n):
+    res = generate(CFG, params, p[None], n, cache_dtype=jnp.float32)
+    return [int(x) for x in res.tokens[0, len(p): int(res.lengths[0])]]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.key(9), dtype=jnp.float32)
+
+
+def make_backend(params, **kw):
+    """The paged backend under the front door — shape-identical between
+    the dp1 and dp2 variants so the jit cache is shared."""
+    if DP > 1:
+        return ReplicatedServer(
+            CFG, params, data_parallel=DP, num_stages=STAGES,
+            devices=jax.devices()[: STAGES * DP], cache_dtype=jnp.float32,
+            capacity=CAP, kv_block_size=4, kv_blocks=48, **kw,
+        )
+    eng = PipelineEngine(
+        CFG, params, num_stages=STAGES, devices=jax.devices()[:STAGES],
+        cache_dtype=jnp.float32,
+    )
+    return eng.serve(capacity=CAP, kv_block_size=4, kv_blocks=48, **kw)
+
+
+def backend_servers(backend):
+    return list(getattr(backend, "servers", None) or [backend])
+
+
+def assert_allocators_drained(backend):
+    for s in backend_servers(backend):
+        s._alloc.check()
+        assert s._alloc.in_use == 0, (
+            f"leaked KV blocks: {s._alloc.in_use} still in use"
+        )
+
+
+@pytest.fixture(scope="module")
+def backend(params):
+    b = make_backend(params)
+    yield b
+    b.close()
+
+
+def make_ingress(backend, **kw):
+    ing = IngressServer(backend, poll_interval_s=0.0005, **kw)
+    ing.start()
+    return ing
+
+
+def post(port, body, headers=None, timeout=120.0, method="POST",
+         path="/v1/completions"):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(
+            method, path, json.dumps(body) if body is not None else None,
+            {"Content-Type": "application/json", **(headers or {})},
+        )
+        resp = conn.getresponse()
+        data = resp.read()
+        return resp.status, dict(resp.getheaders()), (
+            json.loads(data) if data else None
+        )
+    finally:
+        conn.close()
+
+
+def open_stream(port, body, headers=None, timeout=120.0):
+    """POST with stream=true; returns (conn, resp) — caller reads SSE."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request(
+        "POST", "/v1/completions", json.dumps({**body, "stream": True}),
+        {"Content-Type": "application/json", **(headers or {})},
+    )
+    return conn, conn.getresponse()
+
+
+def read_sse(resp):
+    """All SSE events up to [DONE] (or stream end)."""
+    events = []
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        line = line.strip()
+        if not line:
+            continue
+        assert line.startswith(b"data: "), line
+        payload = line[len(b"data: "):]
+        if payload == b"[DONE]":
+            break
+        events.append(json.loads(payload))
+    return events
+
+
+def sse_tokens(events):
+    out = []
+    for ev in events:
+        out.extend(ev["choices"][0]["token_ids"])
+    return out
+
+
+# ------------------------------------------------------------- fairness units
+
+
+def test_token_bucket_deterministic_refill():
+    now = [0.0]
+    b = TokenBucket(rate=2.0, burst=4.0, clock=lambda: now[0])
+    assert all(b.try_acquire() for _ in range(4))  # burst drains
+    assert not b.try_acquire()
+    assert b.retry_after() == pytest.approx(0.5)
+    now[0] += 0.5  # one refill interval -> exactly one token
+    assert b.try_acquire() and not b.try_acquire()
+    now[0] += 10.0  # refill caps at burst, not rate * dt
+    assert all(b.try_acquire() for _ in range(4))
+    assert not b.try_acquire()
+    with pytest.raises(ValueError):
+        TokenBucket(rate=0, burst=1)
+
+
+def test_fair_queue_schedules_by_weighted_service():
+    """Dispatch picks the backlogged tenant with the least service / weight
+    — a tenant with twice the weight gets twice the tokens before losing
+    its turn."""
+    fq = FairQueue([
+        TenantConfig("heavy", weight=2.0), TenantConfig("light", weight=1.0),
+    ], allow_anonymous=False)
+    for i in range(3):
+        fq.push("heavy", f"h{i}")
+        fq.push("light", f"l{i}")
+    # equal observed service: 100 tokens each -> heavy's normalized
+    # service is half of light's -> heavy dispatches first
+    fq.charge("heavy", 100)
+    fq.charge("light", 100)
+    assert fq.pop()[0] == "heavy"
+    fq.charge("heavy", 100)  # now 100 vs 100 normalized: tie -> either;
+    fq.charge("heavy", 10)   # push heavy past light
+    assert fq.pop()[0] == "light"
+    assert fq.depth() == 4
+    assert fq.depth("heavy") == 2
+
+
+def test_fair_queue_flood_only_delays_the_flooder():
+    """A tenant that floods 10 requests interleaves behind a light tenant:
+    after the flood is charged for its head-of-line service, the light
+    tenant's fresh request still dispatches next."""
+    fq = FairQueue([TenantConfig("a"), TenantConfig("b")],
+                   allow_anonymous=False)
+    for i in range(10):
+        fq.push("a", f"a{i}")
+    t, _ = fq.pop()
+    assert t == "a"
+    fq.charge("a", 64)  # the dispatched flood request's service lands
+    fq.push("b", "b0")  # light tenant arrives mid-flood
+    assert fq.pop()[0] == "b"  # jumps the remaining 9 flood entries
+    fq.charge("b", 8)
+    assert fq.pop()[0] == "a"
+
+
+def test_fair_queue_idle_service_cannot_be_banked():
+    """A tenant idle while others accumulate service is lifted to the
+    scheduler's virtual time when it becomes backlogged — idleness earns
+    no retroactive monopoly."""
+    fq = FairQueue([TenantConfig("old"), TenantConfig("sleeper")],
+                   allow_anonymous=False)
+    fq.push("old", "o0")
+    assert fq.pop()[0] == "old"
+    fq.charge("old", 1000)
+    fq.push("old", "o1")
+    assert fq.pop()[0] == "old"  # virtual time advances to 1000
+    fq.charge("old", 500)
+    fq.push("sleeper", "s0")  # lifted to vt=1000, NOT 0
+    fq.push("old", "o2")
+    assert fq.service_of("sleeper") == pytest.approx(1000.0)
+    # sleeper still wins the next slot (1000 < 1500) but by its lifted
+    # margin, not by its banked zero
+    assert fq.pop()[0] == "sleeper"
+
+
+def test_tenant_admission_rate_and_queue_caps():
+    now = [0.0]
+    fq = FairQueue(
+        [TenantConfig("t", rate_rps=1.0, burst=2.0, max_queued=2)],
+        allow_anonymous=False, clock=lambda: now[0],
+    )
+    fq.admit_and_push("t", 1)
+    fq.admit_and_push("t", 2)
+    with pytest.raises(TenantQueueFull) as ei:  # queue cap before bucket
+        fq.admit_and_push("t", 3)
+    assert ei.value.retry_after_s > 0
+    fq.pop()
+    fq.pop()
+    with pytest.raises(RateLimited) as ei:  # bucket empty (burst=2 spent)
+        fq.admit_and_push("t", 4)
+    assert ei.value.retry_after_s == pytest.approx(1.0)
+    now[0] += 1.0
+    fq.admit_and_push("t", 5)  # refilled
+
+
+def test_atomic_admission_caps_and_token_conservation():
+    """admit_and_push is atomic (caps can never be overshot between check
+    and enqueue) and cap sheds never draw a rate token — a refused
+    request must not also charge its tenant's bucket."""
+    now = [0.0]
+    fq = FairQueue(
+        [TenantConfig("t", rate_rps=0.001, burst=2.0, max_queued=1)],
+        allow_anonymous=False, clock=lambda: now[0],
+    )
+    fq.admit_and_push("t", "a")  # draws token 1 of 2
+    with pytest.raises(TenantQueueFull):
+        fq.admit_and_push("t", "b")  # queue cap: NO token drawn
+    assert fq.pop() == ("t", "a")
+    fq.admit_and_push("t", "c")  # token 2 still there -> admitted
+    fq.pop()
+    with pytest.raises(RateLimited):
+        fq.admit_and_push("t", "d")  # burst genuinely spent now
+    # the ingress-wide cap sheds 503-typed, also before the bucket
+    g = FairQueue([TenantConfig("u", rate_rps=0.001, burst=2.0)],
+                  allow_anonymous=False)
+    g.admit_and_push("u", "x", total_cap=1)
+    with pytest.raises(GlobalQueueFull) as ei:
+        g.admit_and_push("u", "y", total_cap=1)
+    assert ei.value.retry_after_s > 0
+    g.pop()
+    g.admit_and_push("u", "z", total_cap=1)  # the global shed kept the token
+
+
+def test_tenant_resolution_and_config():
+    fq = FairQueue(
+        [TenantConfig("keyed", key="sk-1"), TenantConfig("open")],
+        allow_anonymous=True,
+    )
+    assert fq.resolve(bearer="sk-1") == "keyed"
+    assert fq.resolve(header="open") == "open"
+    assert fq.resolve() == "default"
+    with pytest.raises(UnknownTenant):
+        fq.resolve(bearer="sk-wrong")
+    with pytest.raises(UnknownTenant):  # a keyed tenant needs its key
+        fq.resolve(header="keyed")
+    with pytest.raises(UnknownTenant):
+        fq.resolve(header="nobody")
+    closed = FairQueue([TenantConfig("keyed", key="k")],
+                       allow_anonymous=False)
+    with pytest.raises(UnknownTenant):
+        closed.resolve()
+    with pytest.raises(ValueError):
+        TenantConfig("bad", weight=0)
+    with pytest.raises(ValueError):
+        TenantConfig("bad", burst=4)  # burst without rate
+    with pytest.raises(ValueError):
+        FairQueue([TenantConfig("x", key="k"), TenantConfig("y", key="k")])
+
+
+def test_load_tenants_config_roundtrip(tmp_path):
+    cfgs, anon = load_tenants_config(
+        '{"tenants": {"a": {"key": "sk-a", "weight": 2, "rate_rps": 5}, '
+        '"b": {"max_queued": 7}}}'
+    )
+    by = {c.name: c for c in cfgs}
+    assert by["a"].weight == 2 and by["a"].rate_rps == 5
+    assert by["b"].max_queued == 7
+    assert anon is False  # a key exists -> anonymous off by default
+    p = tmp_path / "tenants.json"
+    p.write_text('{"tenants": {"solo": {}}, "allow_anonymous": true}')
+    cfgs, anon = load_tenants_config(str(p))
+    assert cfgs[0].name == "solo" and anon is True
+    # the invariants FairQueue would reject must fail AT PARSE TIME (the
+    # CLI's pre-model-load fast-fail depends on it), as ValueError
+    with pytest.raises(ValueError, match="share the same bearer key"):
+        load_tenants_config(
+            '{"tenants": {"a": {"key": "sk-x"}, "b": {"key": "sk-x"}}}'
+        )
+    with pytest.raises(ValueError, match="must be a JSON object"):
+        load_tenants_config("[]")
+
+
+# ------------------------------------------------------------ autoscaler unit
+
+
+class _FakeReq:
+    done = False
+
+
+class _FakeReplica:
+    def __init__(self, queued=0, active=0, rows=2):
+        self._closed = False
+        self._queue = [None] * queued
+        self._rows = [_FakeReq()] * active + [None] * (rows - active)
+
+
+class _FakeRouter:
+    """Duck-typed ReplicatedServer: 3 device groups, spawn/drain tracked."""
+
+    def __init__(self, live=1):
+        self._groups = [0, 1, 2]
+        self.servers = [_FakeReplica() for _ in range(live)]
+        self.min_replicas = 1
+        self.actions = []
+
+    def spawn_replica(self):
+        self.servers.append(_FakeReplica())
+        self.actions.append("spawn")
+
+    def drain(self, d):
+        if len(self.servers) <= self.min_replicas:
+            raise ValueError("below min_replicas")
+        self.servers.pop()
+        self.actions.append(f"drain{d}")
+
+    def least_loaded_group(self):
+        return len(self.servers) - 1
+
+
+def test_autoscaler_hysteresis_spawns_and_drains():
+    now = [0.0]
+    r = _FakeRouter(live=1)
+    sc = Autoscaler(
+        r, min_replicas=1, max_replicas=3, scale_up_load=0.8,
+        scale_down_load=0.3, up_after_s=1.0, down_after_s=2.0,
+        cooldown_s=5.0, clock=lambda: now[0],
+    )
+    # mid-band load: no sustain window even starts
+    r.servers[0]._queue = []
+    r.servers[0]._rows = [_FakeReq(), None]
+    assert sc.tick() is None  # load 0.5
+    # high load must SUSTAIN for up_after_s before a spawn
+    r.servers[0]._queue = [None] * 6
+    assert sc.tick() is None
+    now[0] += 0.5
+    assert sc.tick() is None  # 0.5s < 1.0s sustain
+    now[0] += 0.6
+    assert sc.tick() == "spawn"
+    assert len(r.servers) == 2
+    # cooldown: still overloaded, no second spawn yet (the high window
+    # restarts and accrues THROUGH the cooldown)
+    now[0] += 1.0
+    assert sc.tick() is None
+    now[0] += 5.0  # cooldown over, high sustained right through it
+    assert sc.tick() == "spawn"
+    assert len(r.servers) == 3
+    # load collapses: drain after the LONGER down window, outside cooldown
+    for s in r.servers:
+        s._queue = []
+        s._rows = [None, None]
+    now[0] += 5.0
+    assert sc.tick() is None  # starts the low-sustain window
+    now[0] += 1.0
+    assert sc.tick() is None  # 1s < 2s
+    now[0] += 1.1
+    assert sc.tick() == "drain"
+    assert len(r.servers) == 2
+    now[0] += 10.0
+    assert sc.tick() is None  # the low window restarted after the drain
+    now[0] += 2.1
+    assert sc.tick() == "drain"
+    assert len(r.servers) == 1
+    # at min_replicas the drain path refuses
+    now[0] += 10.0
+    assert sc.tick() is None
+    now[0] += 2.1
+    assert sc.tick() is None
+    assert len(r.servers) == 1
+    with pytest.raises(ValueError):
+        Autoscaler(r, scale_up_load=0.2, scale_down_load=0.5)
+
+
+def test_autoscaler_load_signal_counts_ingress_backlog():
+    r = _FakeRouter(live=2)  # 4 slots
+    backlog = [0]
+    sc = Autoscaler(r, extra_load=lambda: backlog[0])
+    assert sc.load() == 0.0
+    backlog[0] = 6
+    assert sc.load() == pytest.approx(1.5)
+    r.servers[0]._rows = [_FakeReq(), _FakeReq()]
+    assert sc.load() == pytest.approx(2.0)
+
+
+# ----------------------------------------------------------------- HTTP e2e
+
+
+def test_completion_roundtrip_token_exact(backend, params):
+    """POST /v1/completions with token ids: the response's token_ids are
+    token-identical to the monolithic oracle, usage adds up, and the
+    response id carries the backend request id the trace spans log."""
+    ing = make_ingress(backend)
+    try:
+        p = prompt(101)
+        want = oracle(params, p, 8)
+        status, headers, body = post(ing.port, {
+            "prompt": [int(t) for t in p], "max_tokens": 8,
+        })
+        assert status == 200
+        choice = body["choices"][0]
+        assert choice["token_ids"] == want
+        assert choice["finish_reason"] in ("length", "stop")
+        assert body["usage"]["prompt_tokens"] == len(p)
+        assert body["usage"]["completion_tokens"] == len(want)
+        assert body["id"].startswith("cmpl-")
+        assert headers["X-Request-Id"] == body["id"]
+        assert body["object"] == "text_completion"
+    finally:
+        ing.stop()
+
+
+def test_sse_stream_token_exact(backend, params):
+    """stream=true: SSE events carry the token ids incrementally, the
+    final event has finish_reason + usage, and the stream terminates with
+    [DONE]."""
+    ing = make_ingress(backend)
+    try:
+        p = prompt(102)
+        want = oracle(params, p, 8)
+        conn, resp = open_stream(
+            ing.port, {"prompt": [int(t) for t in p], "max_tokens": 8},
+        )
+        try:
+            assert resp.status == 200
+            assert resp.getheader("Content-Type") == "text/event-stream"
+            events = read_sse(resp)
+        finally:
+            conn.close()
+        assert sse_tokens(events) == want
+        assert events[-1]["choices"][0]["finish_reason"] in ("length", "stop")
+        assert events[-1]["usage"]["completion_tokens"] == len(want)
+        assert all(ev["id"] == events[0]["id"] for ev in events)
+    finally:
+        ing.stop()
+
+
+def test_bad_requests_get_400_not_crashes(backend):
+    ing = make_ingress(backend)
+    try:
+        for body in (
+            {"max_tokens": 4},                       # no prompt
+            {"prompt": [], "max_tokens": 4},         # empty prompt
+            {"prompt": "text", "max_tokens": 4},     # no tokenizer
+            {"prompt": [1, 2], "max_tokens": 0},     # bad budget
+            {"prompt": [1, 2], "max_tokens": 10_000},  # over capacity
+        ):
+            status, _, payload = post(ing.port, body)
+            assert status == 400, (body, payload)
+            assert payload["error"]["type"] == "bad_request"
+        status, _, _ = post(ing.port, {"prompt": [1, 2], "max_tokens": 4},
+                            path="/nope")
+        assert status == 404
+        # the daemon is still fine after the garbage
+        status, _, body = post(ing.port, {"prompt": [1, 2, 3],
+                                          "max_tokens": 4})
+        assert status == 200 and len(body["choices"][0]["token_ids"]) == 4
+    finally:
+        ing.stop()
+
+
+def test_tenant_auth_and_unknown_401(backend):
+    ing = make_ingress(backend, tenants=[
+        TenantConfig("alice", key="sk-alice"), TenantConfig("open"),
+    ], allow_anonymous=False)
+    try:
+        ok = {"prompt": [1, 2, 3], "max_tokens": 2}
+        status, _, _ = post(ing.port, ok,
+                            {"Authorization": "Bearer sk-alice"})
+        assert status == 200
+        status, _, _ = post(ing.port, ok, {"X-Tenant": "open"})
+        assert status == 200
+        status, _, body = post(ing.port, ok)
+        assert status == 401 and body["error"]["type"] == "unauthorized"
+        status, _, _ = post(ing.port, ok,
+                            {"Authorization": "Bearer sk-wrong"})
+        assert status == 401
+        status, _, _ = post(ing.port, ok, {"X-Tenant": "alice"})
+        assert status == 401  # a keyed tenant must present its key
+    finally:
+        ing.stop()
+
+
+def test_rate_limit_429_with_retry_after(backend):
+    """Over-rate requests shed EARLY with 429 + Retry-After and count as
+    rejected — they never enter the queue to die of timeout."""
+    ing = make_ingress(backend, tenants=[
+        # refill every 10s: wall time inside the test can never sneak an
+        # extra token into the bucket
+        TenantConfig("limited", rate_rps=0.1, burst=2.0),
+    ], allow_anonymous=False)
+    try:
+        rl0 = counter_value("server_rejected_total", reason="rate_limit")
+        ok = {"prompt": [1, 2, 3], "max_tokens": 2}
+        hdr = {"X-Tenant": "limited"}
+        statuses = []
+        for _ in range(5):  # burst 2 admits, the rest shed
+            status, headers, body = post(ing.port, ok, hdr)
+            statuses.append(status)
+            if status == 429:
+                assert int(headers["Retry-After"]) >= 1
+                assert body["error"]["type"] == "rate_limited"
+        assert statuses.count(200) == 2
+        assert statuses.count(429) == 3
+        assert counter_value(
+            "server_rejected_total", reason="rate_limit"
+        ) == rl0 + 3
+        assert counter_value(
+            "server_tenant_throttled_total", tenant="limited", reason="rate"
+        ) >= 3
+    finally:
+        ing.stop()
+
+
+def test_draining_503_and_healthz(backend):
+    """begin_drain (the SIGTERM path): new requests answer 503 +
+    Retry-After, /healthz flips 503 DRAINING — a rolling restart pulls
+    the pod from rotation instead of killing streams."""
+    ing = make_ingress(backend)
+    try:
+        status, _, body = post(ing.port, None, method="GET",
+                               path="/healthz")
+        assert status == 200 and body["status"] == "ok"
+        ing.begin_drain()
+        status, headers, body = post(
+            ing.port, {"prompt": [1, 2], "max_tokens": 2}
+        )
+        assert status == 503
+        assert body["error"]["type"] == "draining"
+        assert int(headers["Retry-After"]) >= 1
+        status, _, body = post(ing.port, None, method="GET",
+                               path="/healthz")
+        assert status == 503 and body["status"] == "DRAINING"
+    finally:
+        ing.stop()
+
+
+def test_deadline_header_propagates_to_backend(backend):
+    """X-Deadline-Ms rides into the backend's typed deadline machinery: a
+    budget too small for the requested decode 504s mid-flight (and is
+    counted), instead of running to completion."""
+    ing = make_ingress(backend)
+    try:
+        d0 = counter_value("server_ingress_requests_total",
+                           tenant="default", outcome="deadline")
+        status, _, body = post(
+            ing.port,
+            {"prompt": [int(t) for t in prompt(103)], "max_tokens": 56},
+            {"X-Deadline-Ms": "30"},
+        )
+        assert status == 504, body
+        assert body["error"]["type"] == "deadline"
+        assert counter_value(
+            "server_ingress_requests_total",
+            tenant="default", outcome="deadline",
+        ) == d0 + 1
+        # a request with a workable budget still completes
+        status, _, body = post(
+            ing.port, {"prompt": [1, 2, 3], "max_tokens": 2},
+            {"X-Deadline-Ms": "60000"},
+        )
+        assert status == 200
+        assert_allocators_drained(backend)
+    finally:
+        ing.stop()
+
+
+def test_disconnect_mid_stream_cancels_row_and_frees_blocks(backend):
+    """The acceptance criterion's hygiene half: a client that vanishes
+    mid-SSE gets its backend row cancelled and every KV block returns to
+    the pool (allocator ``check()`` clean, in_use back to zero)."""
+    ing = make_ingress(backend)
+    try:
+        cancelled0 = sum(
+            s.counters.requests_cancelled for s in backend_servers(backend)
+        )
+        conn, resp = open_stream(
+            ing.port,
+            {"prompt": [int(t) for t in prompt(104)], "max_tokens": 48},
+        )
+        assert resp.status == 200
+        got = []
+        while len(got) < 2:  # prove the stream was live, then vanish
+            ev_line = resp.readline().strip()
+            if not ev_line:
+                continue
+            payload = ev_line[len(b"data: "):]
+            got.extend(json.loads(payload)["choices"][0]["token_ids"])
+        # close-delimited response: the response object owns the socket
+        # (http.client passed it over) — closing it sends the FIN the
+        # server's next flush trips over
+        resp.close()
+        conn.close()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            done = sum(
+                s.counters.requests_cancelled
+                for s in backend_servers(backend)
+            ) > cancelled0
+            if done and all(
+                s._alloc.in_use == 0 for s in backend_servers(backend)
+            ):
+                break
+            time.sleep(0.02)
+        assert sum(
+            s.counters.requests_cancelled for s in backend_servers(backend)
+        ) == cancelled0 + 1
+        assert_allocators_drained(backend)
+        assert counter_value(
+            "server_ingress_requests_total",
+            tenant="default", outcome="disconnect",
+        ) >= 1
+    finally:
+        ing.stop()
+
+
+def test_global_overload_sheds_503(backend):
+    """The global ingress queue cap sheds with 503 + Retry-After while
+    admitted work still completes."""
+    ing = make_ingress(backend, max_queue=2, dispatch_depth=1)
+    try:
+        ov0 = counter_value("server_rejected_total",
+                            reason="ingress_queue_full")
+        results = []
+        lock = threading.Lock()
+
+        def worker(i):
+            r = post(ing.port, {
+                "prompt": [int(t) for t in prompt(110 + i)],
+                "max_tokens": 12,
+            })
+            with lock:
+                results.append(r)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        statuses = sorted(s for s, _, _ in results)
+        assert statuses.count(200) >= 2  # dispatched + queued work lands
+        assert 503 in statuses  # and the overflow shed early
+        for s, headers, body in results:
+            if s == 503:
+                assert int(headers["Retry-After"]) >= 1
+                assert body["error"]["type"] == "overloaded"
+        assert counter_value(
+            "server_rejected_total", reason="ingress_queue_full"
+        ) > ov0
+        assert_allocators_drained(backend)
+    finally:
+        ing.stop()
+
+
+def test_flood_tenant_cannot_starve_light_tenant(backend, params):
+    """Weighted fair queueing end to end over HTTP: tenant A floods 10
+    long requests; tenant B's short requests, submitted after the whole
+    flood, still interleave — B finishes before A's flood does, and B's
+    output is token-identical to the unloaded oracle."""
+    ing = make_ingress(backend, tenants=[
+        TenantConfig("flood"), TenantConfig("calm"),
+    ], allow_anonymous=False, dispatch_depth=2)
+    try:
+        a_results, b_results = [], []
+        a_done_at, b_done_at = [], []
+        lock = threading.Lock()
+
+        def flood(i):
+            r = post(ing.port, {
+                "prompt": [int(t) for t in prompt(120 + i)],
+                "max_tokens": 32,
+            }, {"X-Tenant": "flood"}, timeout=300)
+            with lock:
+                a_results.append(r)
+                a_done_at.append(time.monotonic())
+
+        def calm(i):
+            r = post(ing.port, {
+                "prompt": [int(t) for t in prompt(140 + i)],
+                "max_tokens": 4,
+            }, {"X-Tenant": "calm"}, timeout=300)
+            with lock:
+                b_results.append(r)
+                b_done_at.append(time.monotonic())
+
+        a_threads = [
+            threading.Thread(target=flood, args=(i,)) for i in range(10)
+        ]
+        for t in a_threads:
+            t.start()
+        time.sleep(0.05)  # the flood is queued ahead of B
+        b_threads = [
+            threading.Thread(target=calm, args=(i,)) for i in range(3)
+        ]
+        for t in b_threads:
+            t.start()
+        for t in a_threads + b_threads:
+            t.join(timeout=300)
+        assert all(s == 200 for s, _, _ in a_results + b_results)
+        # fairness: B (12 tokens of service) jumped the 320-token flood —
+        # at B's last completion a solid chunk of A was still pending,
+        # where strict FIFO would have parked B behind ALL of A
+        still_pending = sum(1 for t in a_done_at if t > max(b_done_at))
+        assert still_pending >= 2, (
+            f"light tenant finished behind the flood "
+            f"(only {still_pending} flood request(s) outlived it)"
+        )
+        # token-identity: B's outputs match the unloaded oracle exactly
+        want = {
+            tuple(int(t) for t in prompt(140 + i)): oracle(
+                params, prompt(140 + i), 4
+            )
+            for i in range(3)
+        }
+        for _, _, body in b_results:
+            ids = body["choices"][0]["token_ids"]
+            assert ids in want.values()
+        assert_allocators_drained(backend)
+    finally:
+        ing.stop()
+
+
+# ----------------------------------------- the end-to-end acceptance chaos
+
+
+def test_two_tenants_flood_autoscale_end_to_end(params):
+    """ISSUE 9 acceptance: two tenants over HTTP; A floods at ~10x its
+    rate limit while B streams steadily. B completes token-identical to
+    an unloaded run; A's overflow is rejected 429 + Retry-After (no
+    queue-timeout deaths); a mid-stream disconnect releases its KV blocks
+    (allocator check clean); the autoscaler spawns a replica under the
+    flood and drains back to min_replicas after; zero dropped/duplicated
+    tokens across the resize; the autoscale counters match."""
+    rsrv = ReplicatedServer(
+        CFG, params, data_parallel=2, num_stages=STAGES,
+        devices=jax.devices()[: STAGES * 2], cache_dtype=jnp.float32,
+        capacity=CAP, kv_block_size=4, kv_blocks=48, min_replicas=1,
+    )
+    rsrv.drain(1)  # start at the floor; the flood must earn the spawn
+    spawns0 = counter_value("server_autoscale_spawns_total")
+    drains0 = counter_value("server_autoscale_drains_total")
+    rate0 = counter_value("server_rejected_total", reason="rate_limit")
+    ing = None
+    try:
+        scaler = Autoscaler(
+            rsrv, min_replicas=1, max_replicas=2,
+            scale_up_load=0.6, scale_down_load=0.2,
+            up_after_s=0.02, down_after_s=0.4, cooldown_s=0.1,
+        )
+        ing = IngressServer(
+            rsrv,
+            tenants=[
+                TenantConfig("a", rate_rps=3.0, burst=4.0),
+                TenantConfig("b", weight=1.0),
+            ],
+            allow_anonymous=False,
+            autoscaler=scaler,
+            poll_interval_s=0.0005,
+            # tick fast: the warm-cache CPU flood's high-load window is
+            # short, and the spawn must fire inside it
+            autoscale_interval_s=0.005,
+        )
+        scaler._extra_load = ing.fair.depth
+        ing.start()
+
+        # ---- tenant A floods ~10x its admitted rate from a thread -----
+        a_statuses, a_headers, a_bodies = [], [], []
+        a_lock = threading.Lock()
+        flood_done = threading.Event()
+
+        def flood():
+            threads = []
+
+            def one(i):
+                s, h, b = post(ing.port, {
+                    "prompt": [int(t) for t in prompt(200 + i)],
+                    "max_tokens": 8,
+                }, {"X-Tenant": "a"}, timeout=300)
+                with a_lock:
+                    a_statuses.append(s)
+                    a_headers.append(h)
+                    a_bodies.append(b)
+
+            for i in range(30):
+                t = threading.Thread(target=one, args=(i,))
+                t.start()
+                threads.append(t)
+                time.sleep(0.01)  # 30 requests in ~0.3s vs 3 rps admitted
+            for t in threads:
+                t.join(timeout=300)
+            flood_done.set()
+
+        flood_thread = threading.Thread(target=flood)
+        flood_thread.start()
+
+        # ---- tenant B streams steadily through the flood ---------------
+        b_prompts = [prompt(300 + i) for i in range(4)]
+        b_want = [oracle(params, p, 8) for p in b_prompts]
+        b_got = []
+        for p in b_prompts:
+            conn, resp = open_stream(
+                ing.port, {"prompt": [int(t) for t in p], "max_tokens": 8},
+                {"X-Tenant": "b"}, timeout=300,
+            )
+            try:
+                assert resp.status == 200
+                b_got.append(sse_tokens(read_sse(resp)))
+            finally:
+                conn.close()
+
+        # ---- a mid-stream disconnect during the storm ------------------
+        conn, resp = open_stream(
+            ing.port,
+            {"prompt": [int(t) for t in prompt(400)], "max_tokens": 48},
+            {"X-Tenant": "b"}, timeout=300,
+        )
+        assert resp.status == 200
+        resp.readline()  # at least one event is on the wire
+        resp.close()  # the response owns the socket: FIN goes out now
+        conn.close()
+
+        flood_thread.join(timeout=300)
+        assert flood_done.is_set()
+
+        # ---- B: token-identical to the unloaded run (zero dropped or
+        # duplicated tokens across the autoscaler's resize) --------------
+        assert b_got == b_want
+
+        # ---- A: overflow shed 429 + Retry-After; the admitted remainder
+        # completed (no queue-timeout deaths, no 5xx) ---------------------
+        n_ok = a_statuses.count(200)
+        n_rate = a_statuses.count(429)
+        assert n_ok + n_rate == 30, a_statuses
+        assert n_rate >= 15  # ~10x overdrive -> the majority sheds (the
+        # exact count depends on how long the flood takes to send)
+        assert n_ok >= 3  # the burst + refill really were admitted
+        for s, h in zip(a_statuses, a_headers):
+            if s == 429:
+                assert int(h["Retry-After"]) >= 1
+        for s, b in zip(a_statuses, a_bodies):
+            if s == 200:
+                assert len(b["choices"][0]["token_ids"]) == 8
+        assert counter_value(
+            "server_rejected_total", reason="rate_limit"
+        ) == rate0 + n_rate
+
+        # ---- autoscaler: spawned under the flood... --------------------
+        assert counter_value(
+            "server_autoscale_spawns_total"
+        ) >= spawns0 + 1, "the flood never triggered a spawn"
+
+        # ---- ...and drained back to min_replicas once idle -------------
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if (
+                len(rsrv.servers) == 1
+                and counter_value("server_autoscale_drains_total")
+                >= drains0 + 1
+            ):
+                break
+            time.sleep(0.05)
+        assert len(rsrv.servers) == 1
+        assert counter_value(
+            "server_autoscale_drains_total"
+        ) >= drains0 + 1
+
+        # ---- hygiene: every KV block came home -------------------------
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if all(s._alloc.in_use == 0 for s in rsrv.servers):
+                break
+            time.sleep(0.02)
+        assert_allocators_drained(rsrv)
+    finally:
+        if ing is not None:
+            ing.stop()
+        rsrv.close()
+
+
+def test_ingress_stop_sheds_queued_requests(backend):
+    """stop() during traffic: queued entries answer 503, nothing hangs."""
+    ing = make_ingress(backend, dispatch_depth=1)
+    results = []
+    lock = threading.Lock()
+
+    def worker(i):
+        r = post(ing.port, {
+            "prompt": [int(t) for t in prompt(160 + i)], "max_tokens": 16,
+        }, timeout=60)
+        with lock:
+            results.append(r)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.15)
+    ing.stop()
+    for t in threads:
+        t.join(timeout=60)
+    assert len(results) == 4
+    assert all(s in (200, 503) for s, _, _ in results)

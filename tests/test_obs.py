@@ -472,3 +472,63 @@ def test_engine_placement_swap_metrics():
     assert swaps.value == before + 2
     assert REGISTRY.get("engine_pipeline_stages").value == 2
     assert REGISTRY.get("engine_placement_swap_seconds").labels().count >= 2
+
+
+def test_exposition_survives_client_disconnect(capfd):
+    """ISSUE 9 satellite: a scraper that closes its socket early must not
+    splatter a handler-thread traceback — the write guard swallows the
+    broken pipe and the server keeps answering the next request."""
+    import socket
+    import time as _time
+    import urllib.request as _url
+
+    from llm_sharding_tpu.obs.http import write_ignoring_disconnect
+
+    # unit: the guard reports the disconnect instead of raising
+    class _Gone:
+        def write(self, data):
+            raise BrokenPipeError("client went away")
+
+    class _Reset:
+        def write(self, data):
+            raise ConnectionResetError("RST")
+
+    class _Fine:
+        wrote = b""
+
+        def write(self, data):
+            self.wrote += data
+
+    assert write_ignoring_disconnect(_Gone(), b"x") is False
+    assert write_ignoring_disconnect(_Reset(), b"x") is False
+    f = _Fine()
+    assert write_ignoring_disconnect(f, b"body") is True
+    assert f.wrote == b"body"
+
+    # integration: a socket that closes right after the request line —
+    # the handler thread must survive and the endpoint must keep serving
+    r = Registry()
+    r.counter("c_total", "t").inc(3)
+    ms = MetricsServer(port=0, registry=r)
+    port = ms.start()
+    try:
+        for _ in range(3):
+            s = socket.create_connection(("127.0.0.1", port), timeout=5)
+            s.sendall(
+                b"GET /statz HTTP/1.1\r\nHost: x\r\n"
+                b"Connection: close\r\n\r\n"
+            )
+            # vanish without reading the response
+            s.setsockopt(
+                socket.SOL_SOCKET, socket.SO_LINGER,
+                b"\x01\x00\x00\x00\x00\x00\x00\x00",  # RST on close
+            )
+            s.close()
+        _time.sleep(0.2)  # let the handler threads hit the dead sockets
+        with _url.urlopen(f"http://127.0.0.1:{port}/metrics") as resp:
+            assert resp.status == 200
+            assert b"c_total 3" in resp.read()
+    finally:
+        ms.stop()
+    err = capfd.readouterr().err
+    assert "Traceback" not in err, err

@@ -513,3 +513,198 @@ def test_replica_step_site_keyed_per_group():
     # loudly, not vacuously)
     with pytest.raises(ValueError, match="unknown fault site"):
         FaultSpec("replica_crash")
+
+
+# -------------------------------------------------- ingress chaos (ISSUE 9)
+
+
+def _ingress_over(eng, fault_plan=None, tenants=None, **serve_kw):
+    """A paged server + HTTP front door for the ingress chaos scenarios
+    (paged so the KV-block hygiene assertions have an allocator to audit)."""
+    from llm_sharding_tpu.runtime.ingress import IngressServer
+
+    srv = eng.serve(
+        capacity=64, kv_block_size=4, kv_blocks=80, **serve_kw
+    )
+    ing = IngressServer(
+        srv, fault_plan=fault_plan, tenants=tenants,
+        poll_interval_s=0.0005,
+    )
+    ing.start()
+    return srv, ing
+
+
+def _post(port, body, headers=None, timeout=120.0):
+    import http.client as _hc
+    import json as _json
+
+    conn = _hc.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(
+            "POST", "/v1/completions", _json.dumps(body),
+            {"Content-Type": "application/json", **(headers or {})},
+        )
+        resp = conn.getresponse()
+        data = resp.read()
+        return resp.status, dict(resp.getheaders()), (
+            _json.loads(data) if data else None
+        )
+    finally:
+        conn.close()
+
+
+def test_http_request_fault_site_sheds_typed(setup):
+    """An injected ``http_request`` fault (infrastructure trouble at the
+    front door, keyed by tenant) answers 503 + Retry-After — no handler
+    traceback, no crashed daemon — and the very next request serves."""
+    params, eng = setup
+    plan = FaultPlan.transient_at("http_request", 0, key="default")
+    srv, ing = _ingress_over(eng, fault_plan=plan)
+    try:
+        f0 = counter_value(
+            "server_ingress_requests_total",
+            tenant="default", outcome="fault",
+        )
+        body = {"prompt": [int(t) for t in prompt(81)], "max_tokens": 4}
+        status, headers, payload = _post(ing.port, body)
+        assert status == 503
+        assert payload["error"]["type"] == "ingress_fault"
+        assert int(headers["Retry-After"]) >= 1
+        assert counter_value(
+            "server_ingress_requests_total",
+            tenant="default", outcome="fault",
+        ) == f0 + 1
+        # the shed was EARLY: the backend never saw the request
+        assert srv.counters.requests_submitted == 0
+        status, _, payload = _post(ing.port, body)
+        assert status == 200
+        assert len(payload["choices"][0]["token_ids"]) == 4
+        assert plan.stats()["total_fires"] == 1
+    finally:
+        ing.stop()
+        srv.close()
+
+
+def test_slow_client_fault_frees_row_and_kv_blocks(setup):
+    """A ``slow_client`` fault mid-SSE (the client stalled/vanished,
+    deterministically injected at the second event write) takes the real
+    disconnect path: the backend row is cancelled and every KV block
+    returns to the pool — the allocator audits clean."""
+    from llm_sharding_tpu.runtime.faults import FaultPlan as FP
+
+    params, eng = setup
+    plan = FP([FaultSpec("slow_client", "transient", at=(1,),
+                         key="default")])
+    srv, ing = _ingress_over(eng, fault_plan=plan)
+    try:
+        c0 = srv.counters.requests_cancelled
+        d0 = counter_value(
+            "server_ingress_requests_total",
+            tenant="default", outcome="disconnect",
+        )
+        import http.client as _hc
+        import json as _json
+
+        conn = _hc.HTTPConnection("127.0.0.1", ing.port, timeout=120)
+        conn.request(
+            "POST", "/v1/completions",
+            _json.dumps({
+                "prompt": [int(t) for t in prompt(82)],
+                "max_tokens": 48, "stream": True,
+            }),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        first = resp.readline()  # event 0 made it out before the stall
+        assert first.startswith(b"data: ")
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            if (
+                srv.counters.requests_cancelled == c0 + 1
+                and srv._alloc.in_use == 0
+            ):
+                break
+            time.sleep(0.02)
+        conn.close()
+        assert srv.counters.requests_cancelled == c0 + 1
+        srv._alloc.check()
+        assert srv._alloc.in_use == 0, (
+            f"disconnect leaked {srv._alloc.in_use} KV block(s)"
+        )
+        assert counter_value(
+            "server_ingress_requests_total",
+            tenant="default", outcome="disconnect",
+        ) == d0 + 1
+        assert plan.stats()["total_fires"] == 1
+    finally:
+        ing.stop()
+        srv.close()
+
+
+def test_flood_tenant_leaves_other_tenant_ttft_bounded(setup):
+    """Tenant A floods; tenant B's p99 TTFT stays a small fraction of the
+    flood's wall time — starvation (strict FIFO) would push B's first
+    token to roughly the END of the flood."""
+    import http.client as _hc
+    import json as _json
+    import threading as _th
+
+    from llm_sharding_tpu.runtime.fairness import TenantConfig
+
+    params, eng = setup
+    srv, ing = _ingress_over(
+        eng, tenants=[TenantConfig("a"), TenantConfig("b")],
+    )
+    try:
+        t0 = time.monotonic()
+        a_done = []
+        lock = _th.Lock()
+
+        def one_flood(i):
+            _post(ing.port, {
+                "prompt": [int(t) for t in prompt(90 + i)],
+                "max_tokens": 32,
+            }, {"X-Tenant": "a"}, timeout=300)
+            with lock:
+                a_done.append(time.monotonic())
+
+        flood = [_th.Thread(target=one_flood, args=(i,)) for i in range(8)]
+        for t in flood:
+            t.start()
+        time.sleep(0.05)
+        # B: three streaming requests THROUGH the flood, TTFT measured
+        # client-side at the first SSE event
+        ttfts = []
+        for i in range(3):
+            conn = _hc.HTTPConnection("127.0.0.1", ing.port, timeout=300)
+            sent = time.monotonic()
+            conn.request(
+                "POST", "/v1/completions",
+                _json.dumps({
+                    "prompt": [int(t) for t in prompt(95 + i)],
+                    "max_tokens": 4, "stream": True,
+                }),
+                {"Content-Type": "application/json", "X-Tenant": "b"},
+            )
+            resp = conn.getresponse()
+            assert resp.status == 200
+            line = resp.readline()
+            assert line.startswith(b"data: ")
+            ttfts.append(time.monotonic() - sent)
+            while resp.readline():  # drain to [DONE]/EOF
+                pass
+            conn.close()
+        for t in flood:
+            t.join(timeout=300)
+        flood_span = max(a_done) - t0
+        p99 = sorted(ttfts)[-1]  # 3 samples: p99 = worst
+        assert p99 < max(0.5 * flood_span, 0.5), (
+            f"tenant B's worst TTFT {p99:.3f}s looks starved "
+            f"(flood wall time {flood_span:.3f}s)"
+        )
+        srv._alloc.check()
+        assert srv._alloc.in_use == 0
+    finally:
+        ing.stop()
+        srv.close()
