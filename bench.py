@@ -995,6 +995,155 @@ def bench_paged_kernel_serve(on_tpu, engine):
     )
 
 
+def bench_radix_serve(on_tpu, engine):
+    """Automatic prefix caching (ISSUE 10, runtime/radix.py) on the
+    workload it exists for: MULTI-TURN CHAT over a shared system prompt.
+    ``users`` conversations run ``turns`` rounds; every round's prompt is
+    the full transcript so far (system prompt + history + new user
+    tokens), which is exactly the traffic shape where an automatic radix
+    cache pays — the system prompt is shared across users and each user's
+    own history is a growing cached prefix. Cold = prefix_cache off
+    (every round re-prefills the whole transcript); warm = the SAME
+    request stream with the radix cache on.
+
+    In-band asserts (the acceptance bar): the warm run records a NONZERO
+    hit rate and STRICTLY FEWER prefilled tokens than cold, greedy output
+    is TOKEN-IDENTICAL between the runs (the cache may only move work,
+    never change it), and a final round served out of the HOST TIER
+    (every cached block demoted to the pinned host pool, streamed back on
+    the hit) is also token-identical — the bit-exact round-trip claim
+    exercised end to end. Emits warm tok/s (the metric), cold tok/s,
+    TTFT p50s for the reuse rounds, hit rate and the prefill-token
+    totals."""
+    name = (
+        "serve_tok_s_radix_llama3.2-3b_1stage" if on_tpu
+        else "serve_tok_s_radix_tiny_cpu"
+    )
+    from llm_sharding_tpu.parallel.mesh import PIPE_AXIS
+
+    cfg = engine.cfg
+    if on_tpu:
+        rows, capacity, block, chunk_cycles, depth = 16, 2048, 64, 8, 2
+        sys_len, user_len, new_tok, users, turns = 512, 32, 64, 8, 3
+    else:
+        rows, capacity, block, chunk_cycles, depth = 2, 128, 8, 2, 1
+        sys_len, user_len, new_tok, users, turns = 24, 4, 6, 2, 2
+    n_slots = engine.mesh.shape[PIPE_AXIS]
+    kv_blocks = n_slots * rows * capacity // block + 1
+    rng = np.random.default_rng(37)
+    sys_prompt = rng.integers(0, cfg.vocab_size, sys_len).astype(np.int32)
+    # user turns are fixed up front so cold and warm see the same stream
+    user_turns = {
+        (u, t): rng.integers(0, cfg.vocab_size, user_len).astype(np.int32)
+        for u in range(users) for t in range(turns + 1)
+    }
+
+    def run(cache):
+        srv = engine.serve(
+            capacity=capacity, batch_per_slot=rows,
+            chunk_cycles=chunk_cycles, pipeline_depth=depth,
+            kv_block_size=block, kv_blocks=kv_blocks, prefix_cache=cache,
+        )
+        hist = {
+            u: np.concatenate([sys_prompt, user_turns[(u, 0)]])
+            for u in range(users)
+        }
+        session, ttfts, submitted = [], [], 0
+        t0 = time.perf_counter()
+        for t in range(turns):
+            reqs = [(u, srv.submit(hist[u], new_tok)) for u in range(users)]
+            submitted += sum(len(hist[u]) for u in range(users))
+            while any(not r.done for _, r in reqs):
+                srv.step()
+            for u, r in reqs:
+                session.append(list(r.tokens))
+                if t > 0:  # reuse rounds: where the cache moves TTFT
+                    ttfts.append(r.first_token_at - r.submitted_at)
+                hist[u] = np.concatenate([
+                    hist[u], np.asarray(r.tokens, np.int32),
+                    user_turns[(u, t + 1)],
+                ])
+        dt = time.perf_counter() - t0
+        tok_s = sum(len(x) for x in session) / dt
+        stats = (
+            srv.prefix_cache_stats() if cache != "off"
+            else {"hit_tokens": 0, "eligible_tokens": 0, "hit_rate": 0.0}
+        )
+        host_hits, host_round = 0, None
+        if cache == "host":
+            # final round out of the HOST TIER: demote everything the tree
+            # holds, then serve one more turn — the hit streams the blocks
+            # back and must stay bit-exact (token identity checked below)
+            srv._radix.demote_all()
+            r = srv.submit(hist[0], new_tok)
+            while not r.done:
+                srv.step()
+            host_round = list(r.tokens)
+            host_hits = srv.prefix_cache_stats()["host_hit_tokens"]
+        srv.close()
+        gc.collect()
+        return dict(
+            tok_s=tok_s, session=session, ttfts=ttfts,
+            prefill_tokens=submitted - stats["hit_tokens"], stats=stats,
+            host_round=host_round, host_hits=host_hits, hist0=hist[0],
+        )
+
+    run("off")   # compile the cold shapes
+    cold = run("off")
+    run("host")  # compile the prefix-admission shapes at this stream
+    warm = run("host")
+    if warm["session"] != cold["session"]:
+        bad = sum(
+            a != b for a, b in zip(warm["session"], cold["session"])
+        )
+        raise RuntimeError(
+            f"warm-cache serve diverged from cold on {bad}/"
+            f"{len(cold['session'])} requests (greedy must be "
+            "token-identical)"
+        )
+    if warm["stats"]["hit_rate"] <= 0:
+        raise RuntimeError("warm run recorded no prefix-cache hits")
+    if not warm["prefill_tokens"] < cold["prefill_tokens"]:
+        raise RuntimeError(
+            f"warm prefilled {warm['prefill_tokens']} tokens, not fewer "
+            f"than cold's {cold['prefill_tokens']}"
+        )
+    if warm["host_hits"] <= 0:
+        raise RuntimeError("host-tier round recorded no host hits")
+    # the host-tier round's oracle is the cold server serving the same
+    # transcript (identical by construction with the sessions equal)
+    srv = engine.serve(
+        capacity=capacity, batch_per_slot=rows, chunk_cycles=chunk_cycles,
+        pipeline_depth=depth, kv_block_size=block, kv_blocks=kv_blocks,
+    )
+    r = srv.submit(warm["hist0"], new_tok)
+    while not r.done:
+        srv.step()
+    if list(r.tokens) != warm["host_round"]:
+        raise RuntimeError(
+            "host-tier restore diverged from the cold continuation "
+            "(the device->host->device round trip must be bit-exact)"
+        )
+    srv.close()
+    gc.collect()
+
+    def p50(xs):
+        return float(np.percentile(xs, 50)) if xs else 0.0
+
+    emit(
+        name, warm["tok_s"], "tokens/sec", warm["tok_s"] / ANCHOR_TOK_S,
+        cold_tok_s=round(cold["tok_s"], 2),
+        warm_ttft_p50_ms=round(p50(warm["ttfts"]) * 1e3, 2),
+        cold_ttft_p50_ms=round(p50(cold["ttfts"]) * 1e3, 2),
+        hit_rate=round(warm["stats"]["hit_rate"], 4),
+        prefill_tokens_warm=int(warm["prefill_tokens"]),
+        prefill_tokens_cold=int(cold["prefill_tokens"]),
+        host_hit_tokens=int(warm["host_hits"]),
+        kv_block_size=block, kv_blocks=kv_blocks,
+        token_identical=True,
+    )
+
+
 def bench_spec(on_tpu, cfg, params, jax, jnp):
     """Speculative decoding (n-gram self-drafting, runtime/spec.py) on a
     LOOKUP-FRIENDLY workload: the prompt is self-primed — the model's own
@@ -1252,6 +1401,10 @@ def main():
         "serve_tok_s_paged_kernel_llama3.2-3b_1stage" if on_tpu
         else "serve_tok_s_paged_kernel_tiny_cpu"
     )
+    nradix = (
+        "serve_tok_s_radix_llama3.2-3b_1stage" if on_tpu
+        else "serve_tok_s_radix_tiny_cpu"
+    )
     noverload = (
         "serve_overload_goodput_llama3.2-3b_1stage" if on_tpu
         else "serve_overload_goodput_tiny_cpu"
@@ -1323,6 +1476,18 @@ def main():
                 bench_paged_kernel_serve(on_tpu, serve_engine)
             except Exception as e:  # noqa: BLE001
                 emit_error(npagedk, "tokens/sec", e)
+        # automatic prefix caching (multi-turn chat warm-vs-cold) reuses
+        # the same engine
+        if serve_engine is None:
+            emit_error(nradix, "tokens/sec",
+                       "not attempted: serve engine unavailable")
+        elif remaining() < 240:
+            emit_skip(nradix, "tokens/sec", 240)
+        else:
+            try:
+                bench_radix_serve(on_tpu, serve_engine)
+            except Exception as e:  # noqa: BLE001
+                emit_error(nradix, "tokens/sec", e)
         # fault-injection serve (robustness overhead) reuses the serve
         # engine before it is torn down
         if serve_engine is None:
@@ -1421,6 +1586,7 @@ def main():
         emit_error(noverload, "tokens/sec",
                    "not attempted: 3B section failed")
         emit_error(npaged, "tokens/sec", "not attempted: 3B section failed")
+        emit_error(nradix, "tokens/sec", "not attempted: 3B section failed")
         emit_error(nfailover, "tokens/sec",
                    "not attempted: 3B section failed")
         emit_error(nprefix, "x_speedup_vs_full_prefill",

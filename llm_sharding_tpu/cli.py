@@ -203,16 +203,15 @@ def _serve_control(eng, srv, line: str, args):
         print(json.dumps(srv.counters.snapshot()), file=sys.stderr)
         return srv
     if cmd == ":stats":
-        print(
-            json.dumps(
-                {
-                    "counters": srv.counters.snapshot(),
-                    "metrics": REGISTRY.json_snapshot(),
-                },
-                sort_keys=True,
-            ),
-            file=sys.stderr,
-        )
+        stats = {
+            "counters": srv.counters.snapshot(),
+            "metrics": REGISTRY.json_snapshot(),
+        }
+        pc = srv.prefix_cache_stats()
+        if pc is not None:
+            # hit rate + tier occupancy for the operator tuning the cache
+            stats["prefix_cache"] = pc
+        print(json.dumps(stats, sort_keys=True), file=sys.stderr)
         return srv
     if cmd == ":snapshot":
         if len(parts) < 2:
@@ -273,6 +272,10 @@ def _serve_control(eng, srv, line: str, args):
                 kv_block_size=srv.kv_block_size,
                 kv_blocks=srv.kv_blocks,
                 paged_attn=srv.paged_attn,
+                prefix_cache=srv.prefix_cache,
+                host_pool_blocks=(
+                    srv.host_pool_blocks if srv.prefix_cache == "host" else 0
+                ),
             )
 
         try:
@@ -415,6 +418,24 @@ def cmd_serve(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if getattr(args, "prefix_cache", "off") != "off" and not args.kv_block_size:
+        print(
+            f"error: --prefix-cache {args.prefix_cache} needs paged KV "
+            "serving (--kv-block-size/--kv-blocks); the cache shares "
+            "refcounted arena blocks",
+            file=sys.stderr,
+        )
+        return 2
+    if getattr(args, "host_pool_blocks", 0) and getattr(
+        args, "prefix_cache", "off"
+    ) != "host":
+        print(
+            "error: --host-pool-blocks sizes the host-RAM tier — it needs "
+            f"--prefix-cache host (got --prefix-cache "
+            f"{getattr(args, 'prefix_cache', 'off')})",
+            file=sys.stderr,
+        )
+        return 2
     if getattr(args, "tenants_config", None) and not getattr(
         args, "http_port", 0
     ):
@@ -485,6 +506,8 @@ def cmd_serve(args) -> int:
             kv_block_size=args.kv_block_size or None,
             kv_blocks=args.kv_blocks or None,
             paged_attn=getattr(args, "paged_attn", "auto"),
+            prefix_cache=getattr(args, "prefix_cache", "off"),
+            host_pool_blocks=getattr(args, "host_pool_blocks", 0),
             min_replicas=getattr(args, "min_replicas", 1),
         )
         eng = srv.engines[0]
@@ -547,6 +570,11 @@ def cmd_serve(args) -> int:
                     ("kv_blocks", args.kv_blocks or None, srv.kv_blocks),
                     ("paged_attn", getattr(args, "paged_attn", "auto"),
                      srv.paged_attn),
+                    ("prefix_cache", getattr(args, "prefix_cache", "off"),
+                     srv.prefix_cache),
+                    ("host_pool_blocks",
+                     getattr(args, "host_pool_blocks", 0) or None,
+                     srv.host_pool_blocks or None),
                 )
                 if got != used
             ]
@@ -582,6 +610,8 @@ def cmd_serve(args) -> int:
                 kv_block_size=args.kv_block_size or None,
                 kv_blocks=args.kv_blocks or None,
                 paged_attn=getattr(args, "paged_attn", "auto"),
+                prefix_cache=getattr(args, "prefix_cache", "off"),
+                host_pool_blocks=getattr(args, "host_pool_blocks", 0),
             )
         # srv.capacity, not args.capacity: after --restore the daemon runs
         # at the SNAPSHOT's serve_kwargs (ADVICE r5 — the banner used to
@@ -1204,6 +1234,27 @@ def build_parser() -> argparse.ArgumentParser:
         "fallback. The kernel streams only each row's mapped blocks per "
         "decode step, so attention HBM traffic scales with blocks in "
         "flight, not logical context",
+    )
+    s.add_argument(
+        "--prefix-cache", choices=("off", "hbm", "host"), default="off",
+        dest="prefix_cache",
+        help="automatic prefix caching (with --kv-block-size/--kv-blocks): "
+        "a radix tree over token ids indexes every finished request's "
+        "prompt blocks, and every new request transparently reuses its "
+        "longest cached prefix (system prompts, few-shot preambles, "
+        "multi-turn chat history) with zero caller coordination — greedy "
+        "output stays token-identical to the cold path. hbm = cache lives "
+        "in the device arena and cold entries drop under pressure; host = "
+        "cold entries first demote to a pinned host-RAM pool and stream "
+        "back on a later hit, so HBM becomes a cache level instead of a "
+        "hard ceiling. Explicit prefill_prefix handles remain the "
+        "manual/pinned escape hatch",
+    )
+    s.add_argument(
+        "--host-pool-blocks", type=int, default=0, dest="host_pool_blocks",
+        help="host-RAM tier size in KV blocks for --prefix-cache host "
+        "(0 = default to --kv-blocks, an arena-sized pool); host RAM cost "
+        "is pool x the per-block KV bytes",
     )
     s.add_argument(
         "--snapshot-every", type=float, default=0.0, dest="snapshot_every",

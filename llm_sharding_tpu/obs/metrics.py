@@ -435,9 +435,31 @@ KV_WASTE_FRAC = REGISTRY.gauge(
     "server_kv_waste_frac",
     "1 - live tokens / allocated token slots over the in-use blocks: the "
     "internal fragmentation of the paged KV pool (dense serving's "
-    "equivalent figure is 1 - live/capacity per row). Shared prefix "
-    "tokens count once per mapping row, so heavy sharing can drive this "
-    "to 0",
+    "equivalent figure is 1 - live/capacity per row). COLD prefix-cache "
+    "blocks (radix-tree-held, no row mapping them) are excluded from the "
+    "slot denominator — they are reusable capacity, not waste. Shared "
+    "prefix tokens count once per mapping row, so heavy sharing can "
+    "drive this to 0",
+)
+
+# -- automatic prefix cache (runtime/radix.py) ------------------------------
+PREFIX_HIT_TOKENS = REGISTRY.counter(
+    "server_prefix_cache_hit_tokens_total",
+    "Prompt tokens served from the radix prefix cache instead of being "
+    "prefilled (summed over admissions on live servers); the saved "
+    "prefill FLOPs scale with this",
+)
+PREFIX_HIT_RATE = REGISTRY.gauge(
+    "server_prefix_cache_hit_rate",
+    "Cumulative prefix-cache hit rate over live servers: cache-served "
+    "prompt tokens / cache-eligible prompt tokens (requests without an "
+    "explicit PrefixHandle or embeddings entry). 0 with the cache off "
+    "or no eligible traffic yet",
+)
+KV_HOST_TIER_BLOCKS = REGISTRY.gauge(
+    "server_kv_host_tier_blocks",
+    "Prefix-cache blocks currently demoted to the pinned host-RAM pool "
+    "across live servers (streamed back to HBM on a later radix hit)",
 )
 
 #: Decode-attention implementations a live server can run

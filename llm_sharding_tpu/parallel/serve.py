@@ -358,6 +358,64 @@ def prefix_prefill(
     )(stage_layers, layer_masks, head_params, prefix, prefix_len)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "block_size", "tp")
+)
+def gather_prefix_kv(
+    mesh: Mesh,
+    k_arena: jnp.ndarray,  # ServeState.k, paged arena [S, Lp, NB, BS, Nkv, Dh]
+    v_arena: jnp.ndarray,
+    blocks: jnp.ndarray,   # [T] int32 arena block ids covering the prefix
+    block_size: int,
+    tp: int = 1,
+):
+    """Assemble a ``serve_admit``-compatible prefix handle STRAIGHT FROM
+    THE ARENA — the device half of the automatic radix prefix cache
+    (``runtime/radix.py``). Where ``prefix_prefill`` pays the prefix's
+    forward pass to build ``(k [S, Lp, 1, Spx, Nkv, Dh], v, pos)``, this
+    just gathers the ``T`` cached blocks a radix match named: same output
+    layout, zero prefill FLOPs. Every token slot is real (matches are
+    block-aligned by construction), so ``pos`` is simply ``arange(Spx)``.
+
+    The admission that consumes this re-scatters the identical values
+    through the new row's table (shared blocks receive the bytes they
+    already hold — race-free under device program order, same contract as
+    the PrefixHandle broadcast), which is what lets one ``serve_admit``
+    program serve both the explicit-handle and the radix path."""
+    kv_spec = _kv_spec(tp)
+
+    def body(k, v, tbl):
+        k, v = k[0], v[0]  # local [Lp, NB, BS, nkv, Dh]
+        gk = k[:, tbl]     # [Lp, T, BS, nkv, Dh]
+        gv = v[:, tbl]
+        Lp, T = gk.shape[0], gk.shape[1]
+        gk = gk.reshape(Lp, 1, T * block_size, *gk.shape[3:])
+        gv = gv.reshape(Lp, 1, T * block_size, *gv.shape[3:])
+        pos = jnp.arange(T * block_size, dtype=jnp.int32)[None]
+        return gk[None], gv[None], pos[None]
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(kv_spec, kv_spec, P()),
+        out_specs=(kv_spec, kv_spec, P(PIPE_AXIS)),
+        check_vma=False,
+    )(k_arena, v_arena, blocks)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def write_arena_blocks(k_arena, v_arena, blocks, k_host, v_host):
+    """Write host-tier block KV back into the pooled arena (the radix
+    cache streaming a demoted node in on a hit): a block-axis scatter,
+    donated so the arena updates in place — restore never transiently
+    doubles the dominant HBM consumer. Bit-exact: the values written are
+    the bytes ``read`` pulled out (same cache dtype end to end)."""
+    return (
+        k_arena.at[:, :, blocks].set(k_host),
+        v_arena.at[:, :, blocks].set(v_host),
+    )
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
 def serve_cancel_rows(state: ServeState, rows_mask: jnp.ndarray) -> ServeState:
     """Mark rows done from the host between chunks (request cancellation,

@@ -65,6 +65,11 @@ class BlockAllocator:
         self._free: list[int] = list(range(num_blocks - 1, 0, -1))
         self._ref = np.zeros(num_blocks, np.int32)
         self._ref[TRASH_BLOCK] = 1  # pinned forever
+        # blocks whose owning reference belongs to the PREFIX CACHE
+        # (runtime/radix.py) rather than a live row: they are reusable —
+        # evictable on demand — so occupancy/waste accounting must not
+        # read a healthy cold cache as leaked memory
+        self._cached = np.zeros(num_blocks, bool)
 
     # ------------------------------------------------------------------ API
 
@@ -80,6 +85,32 @@ class BlockAllocator:
     @property
     def in_use(self) -> int:
         return self.capacity_blocks - len(self._free)
+
+    # -------------------------------------------- prefix-cache accounting
+
+    @property
+    def cache_held(self) -> int:
+        """Blocks whose owning reference is the prefix cache's."""
+        return int(self._cached.sum())
+
+    @property
+    def cache_cold(self) -> int:
+        """Cache-held blocks no live row currently maps (refcount is the
+        tree's alone): the evictable-on-demand population the KV gauges
+        subtract from \"in use\" so a warm cache never reads as waste."""
+        return int((self._cached & (self._ref == 1)).sum())
+
+    def mark_cached(self, blocks) -> None:
+        """Tag allocated blocks as cache-owned (``runtime/radix.py`` calls
+        this when a node takes ownership of a row's blocks or restores a
+        demoted node)."""
+        for b in blocks:
+            if self._ref[b] < 1 or b == TRASH_BLOCK:
+                raise ValueError(f"mark_cached of unallocated block {b}")
+        self._cached[list(blocks)] = True
+
+    def unmark_cached(self, blocks) -> None:
+        self._cached[list(blocks)] = False
 
     def alloc(self, n: int) -> list[int]:
         """Take ``n`` blocks (refcount 1 each). Raises ``BlockExhausted``
@@ -155,6 +186,8 @@ class BlockAllocator:
                 raise AssertionError(f"bad free-list entry {b}")
             if self._ref[b] != 0:
                 raise AssertionError(f"free block {b} has refcount {self._ref[b]}")
+            if self._cached[b]:
+                raise AssertionError(f"free block {b} still cache-marked")
         held = [
             b for b in range(1, self.num_blocks) if self._ref[b] > 0
         ]

@@ -509,6 +509,8 @@ class PipelineEngine:
         kv_block_size: Optional[int] = None,
         kv_blocks: Optional[int] = None,
         paged_attn: str = "auto",
+        prefix_cache: str = "off",
+        host_pool_blocks: int = 0,
     ):
         """Build a continuous-batching server over this engine's sharded
         arrays (≙ the reference's persistent ``run_worker_loop`` daemon,
@@ -531,6 +533,13 @@ class PipelineEngine:
         attention implementation — ``auto`` (Pallas kernel on TPU for
         Mosaic-eligible shapes, exact XLA gather elsewhere), ``kernel`` or
         ``xla``. See ``ops/paged_attention.py``.
+
+        ``prefix_cache`` (paged only) turns on the AUTOMATIC radix-tree
+        prefix cache (``runtime/radix.py``): every submit transparently
+        reuses the longest cached prompt prefix, finished rows' prompt
+        blocks are indexed instead of freed, and — with ``"host"`` — cold
+        blocks demote to a pinned host-RAM pool of ``host_pool_blocks``
+        (default: arena-sized) before being dropped.
 
         Resilience knobs (see ``runtime/server.py``'s module docstring):
         ``max_queue=`` bounds the submit queue (``QueueFull`` past it),
@@ -565,6 +574,8 @@ class PipelineEngine:
             kv_block_size=kv_block_size,
             kv_blocks=kv_blocks,
             paged_attn=paged_attn,
+            prefix_cache=prefix_cache,
+            host_pool_blocks=host_pool_blocks,
         )
 
     def _shared_server(self, prompt_len: int, max_new: int):

@@ -78,13 +78,14 @@ class _Pending:
     __slots__ = (
         "tenant", "prompt", "prompt_len", "max_new", "temperature", "seed",
         "top_k", "top_p", "stop", "stream", "arrived_at", "deadline_at",
-        "event", "req", "shed", "charged", "rid", "interrupted",
+        "event", "req", "shed", "charged", "rid", "interrupted", "embeds",
     )
 
     def __init__(self, tenant, prompt, prompt_len, rid):
         self.tenant = tenant
         self.prompt = prompt
         self.prompt_len = prompt_len
+        self.embeds = None  # [S, H] hidden states (the /v1/embeddings entry)
         self.rid = rid
         self.max_new = 16
         self.temperature = 0.0
@@ -399,7 +400,14 @@ class IngressServer:
                         e.deadline_at - time.monotonic(), 1e-3
                     )
                 try:
-                    req = self.backend.submit(e.prompt, e.max_new, **kw)
+                    if e.embeds is not None:
+                        # privacy entry over HTTP: the request enters as
+                        # hidden states — token ids never reach this process
+                        req = self.backend.submit_embedding(
+                            e.embeds, e.max_new, **kw
+                        )
+                    else:
+                        req = self.backend.submit(e.prompt, e.max_new, **kw)
                 except QueueFull:
                     # backend backpressure: put the entry back at its
                     # tenant's head, retry next pass — never drop covertly
@@ -552,10 +560,18 @@ class IngressServer:
 
             def do_POST(self):  # noqa: N802 — http.server API
                 path = self.path.split("?", 1)[0].rstrip("/") or "/"
-                if path != "/v1/completions":
-                    self._error(404, "not_found", "try POST /v1/completions")
-                    return
-                server._handle_completion(self)
+                if path == "/v1/completions":
+                    server._handle_completion(self)
+                elif path == "/v1/embeddings":
+                    # the privacy entry (PipelineServer.submit_embedding)
+                    # as an endpoint: 'input' carries [S, H] prompt hidden
+                    # states, the response is an ordinary completion
+                    server._handle_completion(self, embeddings=True)
+                else:
+                    self._error(
+                        404, "not_found",
+                        "try POST /v1/completions or /v1/embeddings",
+                    )
 
         return Handler
 
@@ -596,6 +612,42 @@ class IngressServer:
             rid = self._next_rid
             self._next_rid += 1
         e = _Pending(tenant, ids, int(ids.size), rid)
+        self._apply_knobs(e, body, handler)
+        return e
+
+    def _build_embeddings_entry(
+        self, tenant: str, body: dict, handler
+    ) -> _Pending:
+        """The ``/v1/embeddings`` body: ``input`` is one prompt's hidden
+        states, ``[S, H]`` floats (``engine.embed_prompt`` output — the
+        reference's privacy channel: raw text/ids never leave the node
+        that embedded them). Sampling/stream/deadline knobs are shared
+        with completions; the fair queue charges prefill by ``S``."""
+        arr = body.get("input")
+        if arr is None:
+            raise ValueError(
+                "'input' must carry [seq, hidden] prompt embeddings"
+            )
+        h = np.asarray(arr, np.float32)
+        if h.ndim == 3 and h.shape[0] == 1:
+            h = h[0]
+        if h.ndim != 2 or h.shape[0] < 1:
+            raise ValueError(
+                f"'input' must be a [seq, hidden] float matrix, got shape "
+                f"{h.shape}"
+            )
+        with self._mutex:
+            rid = self._next_rid
+            self._next_rid += 1
+        e = _Pending(tenant, None, int(h.shape[0]), rid)
+        e.embeds = h
+        self._apply_knobs(e, body, handler)
+        return e
+
+    def _apply_knobs(self, e: _Pending, body: dict, handler) -> None:
+        """Sampling/stream/deadline knobs shared by BOTH entry builders —
+        one definition, so a knob added to completions cannot silently
+        skip the embeddings endpoint."""
         e.max_new = int(body.get("max_tokens", self.default_max_new))
         if e.max_new < 1:
             raise ValueError("'max_tokens' must be >= 1")
@@ -615,9 +667,8 @@ class IngressServer:
             if dl_ms <= 0:
                 raise ValueError("X-Deadline-Ms must be > 0")
             e.deadline_at = e.arrived_at + dl_ms / 1000.0
-        return e
 
-    def _handle_completion(self, handler) -> None:
+    def _handle_completion(self, handler, embeddings: bool = False) -> None:
         # -- tenant resolution + typed early shedding ----------------------
         try:
             tenant = self._resolve_tenant(handler)
@@ -647,7 +698,10 @@ class IngressServer:
             return
         try:
             body = self._parse_body(handler)
-            e = self._build_entry(tenant, body, handler)
+            e = (
+                self._build_embeddings_entry(tenant, body, handler)
+                if embeddings else self._build_entry(tenant, body, handler)
+            )
         except (ValueError, TypeError, json.JSONDecodeError) as err:
             self._count(tenant, "bad_request")
             handler._error(400, "bad_request", str(err))

@@ -1,0 +1,637 @@
+"""Automatic prefix cache: a radix tree over token ids whose nodes own
+refcounted KV arena blocks, with an LRU host-RAM tier underneath.
+
+PR 4 made prefix reuse *possible* (``PrefixHandle``: callers prefill a
+shared prefix once and pass the handle with every suffix request). At
+millions-of-users scale the sharing that dominates real traffic — system
+prompts, few-shot preambles, multi-turn chat history — arrives with no
+caller coordination at all, so it must be AUTOMATIC (SGLang's
+RadixAttention, Zheng et al. 2023). This module is the host-side index
+that makes it so:
+
+- **The tree is keyed by token ids from position 0.** KV content is a
+  deterministic function of (token prefix, absolute position), and every
+  served row lays its prompt out contiguously from position 0 in its
+  block table, so a cache block holding tokens ``[i*BS, (i+1)*BS)`` of
+  some prompt is byte-reusable by ANY later request whose prompt starts
+  with the same tokens. Edges carry whole blocks: every node's token key
+  is a multiple of ``block_size`` long, splits happen only at block
+  boundaries, and a divergence inside a block simply ends the match
+  (the partial block is recomputed by the new request's suffix prefill).
+- **Nodes own allocator references.** An inserted block keeps the
+  refcount-1 reference its row held (ownership transfers — no copy);
+  rows that later map a cached block ``share()`` it exactly like PR 4's
+  handle path, so the ``BlockAllocator`` remains the single source of
+  truth for block lifetime. ``refs`` on a node counts the rows currently
+  pinning it (matched at admission, released when the row finishes) —
+  eviction never touches a pinned node.
+- **HBM is a cache level, not a ceiling.** Under allocator pressure
+  (``ensure_free``) cold nodes are evicted in LRU order: first DEMOTED
+  to a bounded host-RAM pool (device→host copy of the blocks' K/V,
+  bit-exact round trip — the arrays come back as the same bytes), then
+  DROPPED entirely when the pool is full or tiering is off. A later
+  match on a demoted node streams it back into freshly allocated device
+  blocks before the row admits.
+
+The tree itself is pure host bookkeeping (numpy only); device I/O goes
+through the two callbacks the owning server provides (``read_kv`` /
+``write_kv``), so this module stays import-light and unit-testable
+without a mesh. NOT thread-safe on its own — the owning server
+serializes every call under its mutex, like ``BlockAllocator``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from .blocks import BlockAllocator, BlockExhausted
+
+__all__ = ["RadixCache", "RadixNode", "RadixRef"]
+
+
+def _common_len(a: np.ndarray, b: np.ndarray) -> int:
+    """Length of the common prefix of two int token arrays."""
+    n = min(a.shape[0], b.shape[0])
+    if n == 0:
+        return 0
+    neq = np.nonzero(a[:n] != b[:n])[0]
+    return int(neq[0]) if neq.size else n
+
+
+class RadixNode:
+    """One edge of the tree: ``key`` tokens (a multiple of ``block_size``
+    long) backed by ``len(key) // block_size`` arena blocks — device block
+    ids in ``blocks`` when resident, or host copies in ``host_kv`` when
+    demoted (never both)."""
+
+    __slots__ = (
+        "key", "blocks", "host_kv", "children", "parent", "refs",
+        "last_used",
+    )
+
+    def __init__(self, key: np.ndarray, blocks, parent):
+        self.key = np.asarray(key, np.int32)
+        self.blocks: list[int] = list(blocks)
+        self.host_kv: Optional[tuple] = None  # (k, v) numpy when demoted
+        self.children: dict[int, "RadixNode"] = {}
+        self.parent: Optional["RadixNode"] = parent
+        self.refs = 0  # live rows pinning this node (admission ↔ release)
+        self.last_used = 0
+
+    def on_device(self) -> bool:
+        return self.host_kv is None
+
+
+class RadixRef:
+    """A pinned match: the path nodes a row holds references on, the
+    matched token count ``n`` and the device block ids covering exactly
+    those ``n`` tokens (in path order). The server maps ``blocks``
+    read-only into the row's table and calls ``release`` when the row
+    leaves."""
+
+    __slots__ = ("nodes", "n", "blocks")
+
+    def __init__(self, nodes: tuple, n: int, blocks: list):
+        self.nodes = nodes
+        self.n = n
+        self.blocks = blocks
+
+
+class RadixCache:
+    """Radix-tree prefix index over a ``BlockAllocator``'s arena blocks
+    with an optional host-RAM tier. See the module docstring."""
+
+    def __init__(
+        self,
+        alloc: BlockAllocator,
+        block_size: int,
+        *,
+        host_pool_blocks: int = 0,
+        read_kv: Optional[Callable] = None,   # (blocks) -> (k_np, v_np)
+        write_kv: Optional[Callable] = None,  # (blocks, k_np, v_np) -> None
+    ):
+        if host_pool_blocks < 0:
+            raise ValueError(
+                f"host_pool_blocks must be >= 0, got {host_pool_blocks}"
+            )
+        if host_pool_blocks and (read_kv is None or write_kv is None):
+            raise ValueError(
+                "a host tier (host_pool_blocks > 0) needs read_kv/write_kv "
+                "callbacks to move block KV across the host boundary"
+            )
+        self.alloc = alloc
+        self.block_size = int(block_size)
+        self.host_pool_blocks = int(host_pool_blocks)
+        self.read_kv = read_kv
+        self.write_kv = write_kv
+        self.root = RadixNode(np.zeros((0,), np.int32), [], None)
+        self._tick = 0
+        # running tallies (read lock-free by the gauge sweep — plain ints)
+        self.device_blocks = 0   # tree-owned blocks resident in HBM
+        self.host_blocks = 0     # tree-owned blocks parked in the host pool
+        self.hit_tokens = 0      # prompt tokens served from the cache
+        self.eligible_tokens = 0  # cacheable prompt tokens seen at admission
+        self.host_hit_tokens = 0  # tokens streamed back from the host tier
+        self.evictions_to_host = 0
+        self.evictions_dropped = 0
+        self.inserted_blocks = 0
+
+    # ------------------------------------------------------------- lookup
+
+    def match_tokens(self, ids) -> int:
+        """Read-only probe: how many tokens of ``ids`` the tree currently
+        covers, rounded down to a block multiple (the routing signal —
+        ``ReplicatedServer._pick`` prefers the replica with the longest
+        match). Touches no refcounts, no LRU state."""
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        node, off = self.root, 0
+        while off < ids.shape[0]:
+            child = node.children.get(int(ids[off]))
+            if child is None:
+                break
+            m = _common_len(child.key, ids[off:])
+            mb = (m // self.block_size) * self.block_size
+            off += mb
+            if mb < child.key.shape[0]:
+                break
+            node = child
+        return off
+
+    def _walk(self, ids: np.ndarray, max_tokens: int) -> list:
+        """Path of ``(node, tokens_used)`` pairs covering the longest
+        block-aligned exact match of ``ids``, capped at ``max_tokens``."""
+        path, node, off = [], self.root, 0
+        while off < ids.shape[0] and off < max_tokens:
+            child = node.children.get(int(ids[off]))
+            if child is None:
+                break
+            lim = min(
+                child.key.shape[0], ids.shape[0] - off, max_tokens - off
+            )
+            m = _common_len(child.key[:lim], ids[off : off + lim])
+            mb = (m // self.block_size) * self.block_size
+            if mb == 0:
+                break
+            path.append((child, mb))
+            off += mb
+            if mb < child.key.shape[0]:
+                break
+            node = child
+        return path
+
+    def take(self, ids, max_tokens: int) -> Optional[RadixRef]:
+        """Match ``ids`` against the tree and PIN the covering nodes for a
+        row about to admit: bumps LRU, increments ``refs`` along the path,
+        and streams any demoted node on the path back to device (fresh
+        blocks, ``write_kv``; eviction of *other* cold nodes may run to
+        make room). A host restore that cannot fit truncates the match at
+        that node. Returns ``None`` on no (block-aligned) match.
+
+        The returned ``RadixRef.blocks`` covers exactly ``ref.n`` tokens;
+        the caller maps them read-only (``BlockAllocator.share``) and MUST
+        ``release`` the ref when the row leaves, whatever the outcome."""
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        path = self._walk(ids, max_tokens)
+        if not path:
+            return None
+        self._tick += 1
+        # pin the WHOLE path before any restore: a restore's room-making
+        # eviction must never be able to touch a later (not-yet-visited)
+        # node of this very match — a dropped path node would feed freed
+        # block ids into the returned ref
+        for node, _ in path:
+            node.refs += 1
+        nodes, blocks, n = [], [], 0
+        ok = True
+        for node, mb in path:
+            if ok and (node.on_device() or self._restore(node)):
+                node.last_used = self._tick
+                nodes.append(node)
+                blocks.extend(node.blocks[: mb // self.block_size])
+                n += mb
+            else:
+                # a host node that cannot stream back truncates the match
+                # here; this and every later node drop their provisional pin
+                ok = False
+                node.refs -= 1
+        if n == 0:
+            return None
+        return RadixRef(tuple(nodes), n, blocks)
+
+    def pin(self, ref: RadixRef) -> None:
+        """Add one more row's pin on an existing ref's path (co-admitted
+        batch rows share the match but release independently)."""
+        for node in ref.nodes:
+            node.refs += 1
+
+    def release(self, ref: RadixRef) -> None:
+        """Drop one row's pins (idempotence is the caller's job — the
+        server releases exactly once per mapped row)."""
+        for node in ref.nodes:
+            if node.refs < 1:
+                raise AssertionError("radix release without a matching pin")
+            node.refs -= 1
+
+    # ------------------------------------------------------------- insert
+
+    def insert(self, ids, blocks) -> set:
+        """Index ``ids`` (block-aligned length) whose KV lives in
+        ``blocks`` (one id per block, in order — a finishing row's table
+        prefix). Where the tree already covers a prefix, the existing
+        nodes win and the corresponding caller blocks are NOT consumed;
+        the uncovered tail becomes a new node that takes OWNERSHIP of its
+        blocks (their allocator reference transfers from the row to the
+        tree). Returns the set of consumed block ids — the caller frees
+        everything else as usual.
+
+        A divergence inside a block, or inside a pinned node's edge (a
+        split would invalidate live ``RadixRef``s), ends the insertion:
+        correctness never depends on indexing everything."""
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        bs = self.block_size
+        if ids.shape[0] % bs:
+            raise ValueError(
+                f"insert length {ids.shape[0]} is not a multiple of the "
+                f"block size {bs}"
+            )
+        blocks = list(blocks)
+        if len(blocks) != ids.shape[0] // bs:
+            raise ValueError(
+                f"{len(blocks)} blocks do not cover {ids.shape[0]} tokens "
+                f"at block size {bs}"
+            )
+        self._tick += 1
+        consumed: set = set()
+        node, off, bi = self.root, 0, 0
+        while off < ids.shape[0]:
+            child = node.children.get(int(ids[off]))
+            if child is None:
+                tail = RadixNode(ids[off:], blocks[bi:], node)
+                tail.last_used = self._tick
+                node.children[int(ids[off])] = tail
+                consumed.update(blocks[bi:])
+                self.alloc.mark_cached(blocks[bi:])
+                self.device_blocks += len(blocks) - bi
+                self.inserted_blocks += len(blocks) - bi
+                break
+            m = _common_len(child.key, ids[off:])
+            if off + m == ids.shape[0] and m <= child.key.shape[0]:
+                child.last_used = self._tick
+                break  # fully covered by this edge (maybe a prefix of it)
+            if m == child.key.shape[0]:
+                off += m
+                # the block CURSOR advances by the edge's block count —
+                # never len(child.blocks), which is 0 for a host-demoted
+                # node (a cold insert walking through one would hand the
+                # tail node blocks belonging to earlier tokens)
+                bi += m // bs
+                child.last_used = self._tick
+                node = child
+                continue
+            # diverged mid-edge: split at the block boundary if possible
+            mb = (m // bs) * bs
+            if mb == 0 or child.refs > 0:
+                break
+            self._split(child, mb)
+            # loop re-enters at the (new) top node: ids[off + mb] now
+            # diverges from its remaining children → fresh leaf next pass
+            continue
+        return consumed
+
+    def _split(self, child: RadixNode, at_tokens: int) -> None:
+        """Split ``child``'s edge at a block boundary: a new TOP node takes
+        the first ``at_tokens`` tokens/blocks, ``child`` keeps the rest as
+        the top's only child. Host-tier KV splits along the block axis."""
+        bs = self.block_size
+        nb = at_tokens // bs
+        parent = child.parent
+        top = RadixNode(child.key[:at_tokens], child.blocks[:nb], parent)
+        top.last_used = child.last_used
+        if child.host_kv is not None:
+            k, v = child.host_kv
+            top.host_kv = (k[:, :, :nb], v[:, :, :nb])
+            top.blocks = []
+            child.host_kv = (k[:, :, nb:], v[:, :, nb:])
+        else:
+            child.blocks = child.blocks[nb:]
+        child.key = child.key[at_tokens:]
+        child.parent = top
+        top.children[int(child.key[0])] = child
+        parent.children[int(top.key[0])] = top
+
+    # ----------------------------------------------------------- eviction
+
+    def evictable_blocks(self) -> int:
+        """Device blocks the cache could free RIGHT NOW (refcount-0
+        subtrees — the admission gate adds this to ``alloc.num_free`` when
+        sizing a wave, so a full-looking pool with a cold cache still
+        admits)."""
+        total = 0
+
+        def walk(n: RadixNode) -> bool:
+            ok = n.refs == 0
+            for c in n.children.values():
+                ok = walk(c) and ok
+            if ok and n is not self.root and n.on_device():
+                nonlocal total
+                total += len(n.blocks)
+            return ok
+
+        walk(self.root)
+        return total
+
+    def _candidates(self) -> list:
+        """Evictable-now nodes (cold subtree, device-resident, no device
+        children — deepest first by construction), LRU order."""
+        out = []
+
+        def walk(n: RadixNode) -> tuple:
+            cold = n.refs == 0
+            dev_child = False
+            for c in n.children.values():
+                c_cold, c_dev = walk(c)
+                cold = cold and c_cold
+                dev_child = dev_child or c_dev or c.on_device()
+            if (
+                cold and n is not self.root and n.on_device()
+                and not dev_child
+            ):
+                out.append(n)
+            return cold, dev_child
+
+        walk(self.root)
+        out.sort(key=lambda n: n.last_used)
+        return out
+
+    def ensure_free(self, n: int) -> bool:
+        """Evict cold nodes (LRU) until the allocator has ``n`` free
+        blocks. True on success; False when everything left is pinned —
+        the caller falls back to its normal exhaustion handling (queue
+        wait / typed error).
+
+        The candidate list is built once and CONSUMED (re-walked only when
+        it empties — evicting a leaf can make its parent newly eligible);
+        a full tree walk + sort per evicted node would be quadratic host
+        work under the server mutex exactly when the cache is loaded."""
+        cands: list = []
+        exhausted = False
+        while self.alloc.num_free < n:
+            while cands:
+                node = cands.pop(0)
+                # pins cannot change mid-call (single-threaded under the
+                # server mutex) but an earlier eviction's subtree drop can
+                # have detached a listed node
+                if node.parent is not None and node.on_device():
+                    self._evict(node)
+                    exhausted = False
+                    break
+            else:
+                if exhausted:
+                    return False
+                cands = self._candidates()
+                exhausted = True
+        return True
+
+    def _evict(self, node: RadixNode) -> None:
+        """Free one cold node's device blocks: demote to the host pool
+        when tiering is on and room can be made (dropping LRU childless
+        host nodes first), else drop the node (plus any host-tier
+        descendants it strands)."""
+        nb = len(node.blocks)
+        if self.host_pool_blocks:
+            # make pool room by dropping the coldest childless host nodes
+            # (one walk+sort per _evict call, consumed as needed)
+            host_leaves: Optional[list] = None
+            while self.host_blocks + nb > self.host_pool_blocks:
+                if host_leaves is None:
+                    host_leaves = sorted(
+                        (
+                            c for c in self._iter_nodes()
+                            # refs == 0: a pinned host node is mid-restore
+                            # by take() — dropping it here would
+                            # double-free its pool accounting and strand
+                            # its incoming blocks
+                            if not c.on_device() and not c.children
+                            and c.refs == 0
+                        ),
+                        key=lambda c: c.last_used,
+                    )
+                if not host_leaves:
+                    break
+                self._drop(host_leaves.pop(0))
+            if self.host_blocks + nb <= self.host_pool_blocks:
+                k, v = self.read_kv(node.blocks)
+                node.host_kv = (np.asarray(k), np.asarray(v))
+                self.alloc.unmark_cached(node.blocks)
+                self.alloc.free(node.blocks)
+                node.blocks = []
+                self.device_blocks -= nb
+                self.host_blocks += nb
+                self.evictions_to_host += 1
+                return
+        self._drop_subtree(node)
+
+    def _restore(self, node: RadixNode) -> bool:
+        """Stream a demoted node back to device: allocate fresh blocks
+        (evicting other cold nodes if needed), write the host copies back
+        (bit-exact — same bytes out as in). False when the pool cannot
+        free enough even after eviction."""
+        k, v = node.host_kv
+        nb = k.shape[2]
+        if not self.ensure_free(nb):
+            return False
+        try:
+            blocks = self.alloc.alloc(nb)
+        except BlockExhausted:  # raced pinned-only pool state
+            return False
+        self.write_kv(blocks, k, v)
+        self.alloc.mark_cached(blocks)
+        node.blocks = blocks
+        node.host_kv = None
+        self.host_blocks -= nb
+        self.device_blocks += nb
+        self.host_hit_tokens += int(node.key.shape[0])
+        return True
+
+    def _drop(self, node: RadixNode) -> None:
+        """Remove one CHILDLESS node from the tree, returning device
+        blocks to the allocator / host blocks to the pool."""
+        if node.children:
+            raise AssertionError("drop of a node with children")
+        if node.on_device():
+            self.alloc.unmark_cached(node.blocks)
+            self.alloc.free(node.blocks)
+            self.device_blocks -= len(node.blocks)
+        else:
+            self.host_blocks -= int(node.key.shape[0]) // self.block_size
+        self.evictions_dropped += 1
+        del node.parent.children[int(node.key[0])]
+        node.parent = None
+        node.blocks = []  # a stale reference must never resurrect freed ids
+        node.host_kv = None
+
+    def _drop_subtree(self, node: RadixNode) -> None:
+        for c in list(node.children.values()):
+            self._drop_subtree(c)
+        self._drop(node)
+
+    def demote_all(self) -> int:
+        """Push every cold device-resident node to the host tier (tests /
+        bench: deterministic host-tier exercise without fabricating
+        allocator pressure). Returns nodes demoted."""
+        if not self.host_pool_blocks:
+            raise ValueError("demote_all needs a host tier")
+        moved = 0
+        while True:
+            cands = self._candidates()
+            if not cands:
+                return moved
+            before = self.evictions_to_host
+            self._evict(cands[0])
+            moved += self.evictions_to_host - before
+
+    def drop_all(self) -> None:
+        """Free every unpinned node (both tiers): the operator's cache
+        flush. Pinned paths stay (live rows depend on them)."""
+        while True:
+            dropped = False
+            for n in list(self._iter_nodes()):
+                if n.refs == 0 and not n.children:
+                    self._drop(n)
+                    dropped = True
+            if not dropped:
+                return
+
+    # -------------------------------------------------------- maintenance
+
+    def _iter_nodes(self):
+        stack = list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            yield n
+
+    def stats(self) -> dict:
+        elig = self.eligible_tokens
+        return {
+            "hit_tokens": self.hit_tokens,
+            "eligible_tokens": elig,
+            "hit_rate": (self.hit_tokens / elig) if elig else 0.0,
+            "host_hit_tokens": self.host_hit_tokens,
+            "device_blocks": self.device_blocks,
+            "host_blocks": self.host_blocks,
+            "host_pool_blocks": self.host_pool_blocks,
+            "nodes": sum(1 for _ in self._iter_nodes()),
+            "evictions_to_host": self.evictions_to_host,
+            "evictions_dropped": self.evictions_dropped,
+        }
+
+    def check(self) -> None:
+        """Tree invariant for the chaos suites: block-aligned edges, one
+        backing tier per node, counters that re-add, every device block
+        cache-marked and refcounted in the allocator."""
+        bs = self.block_size
+        dev = host = 0
+        for n in self._iter_nodes():
+            L = n.key.shape[0]
+            if L == 0 or L % bs:
+                raise AssertionError(f"edge length {L} not block-aligned")
+            if n.refs < 0:
+                raise AssertionError("negative node refcount")
+            if n.parent.children.get(int(n.key[0])) is not n:
+                raise AssertionError("parent/child link broken")
+            if n.on_device():
+                if len(n.blocks) != L // bs:
+                    raise AssertionError(
+                        f"{len(n.blocks)} blocks for {L} tokens"
+                    )
+                for b in n.blocks:
+                    if self.alloc._ref[b] < 1 or not self.alloc._cached[b]:
+                        raise AssertionError(
+                            f"tree block {b} not allocator-backed/marked"
+                        )
+                dev += len(n.blocks)
+            else:
+                if n.blocks:
+                    raise AssertionError("host node still holds device ids")
+                if n.host_kv[0].shape[2] != L // bs:
+                    raise AssertionError("host KV block count mismatch")
+                host += L // bs
+        if dev != self.device_blocks or host != self.host_blocks:
+            raise AssertionError(
+                f"counter drift: dev {dev} vs {self.device_blocks}, "
+                f"host {host} vs {self.host_blocks}"
+            )
+        if self.host_blocks > self.host_pool_blocks:
+            raise AssertionError("host pool over its cap")
+
+    # ----------------------------------------------------------- snapshot
+
+    def snapshot(self) -> dict:
+        """Host-serializable tree: node metadata + a flat array dict
+        (edge keys; host-tier K/V). Node refs are NOT stored — restore
+        re-pins from the restored rows' matches."""
+        nodes, arrays = [], {}
+        index = {self.root: -1}
+        order = []
+        stack = [self.root]
+        while stack:
+            n = stack.pop()
+            for c in n.children.values():
+                index[c] = len(order)
+                order.append(c)
+                stack.append(c)
+        for i, n in enumerate(order):
+            nodes.append({
+                "parent": index[n.parent],
+                "blocks": [int(b) for b in n.blocks],
+                "tier": "hbm" if n.on_device() else "host",
+                "last_used": int(n.last_used),
+            })
+            arrays[f"radix.{i}.key"] = np.asarray(n.key, np.int32)
+            if not n.on_device():
+                arrays[f"radix.{i}.k"] = n.host_kv[0]
+                arrays[f"radix.{i}.v"] = n.host_kv[1]
+        return {
+            "nodes": nodes,
+            "arrays": arrays,
+            "counters": {
+                "hit_tokens": self.hit_tokens,
+                "eligible_tokens": self.eligible_tokens,
+                "host_hit_tokens": self.host_hit_tokens,
+            },
+        }
+
+    def restore(self, snap: dict, arrays: dict) -> None:
+        """Rebuild the tree on a fresh cache whose allocator was already
+        ``restore``d with the device-tier nodes' blocks as owners. Marks
+        device blocks cache-held and recounts both tiers."""
+        if self.device_blocks or self.host_blocks:
+            raise ValueError("restore on a non-empty radix cache")
+        order: list[RadixNode] = []
+        for i, meta in enumerate(snap["nodes"]):
+            parent = (
+                self.root if meta["parent"] == -1 else order[meta["parent"]]
+            )
+            key = np.asarray(arrays[f"radix.{i}.key"], np.int32)
+            node = RadixNode(key, meta["blocks"], parent)
+            node.last_used = int(meta["last_used"])
+            if meta["tier"] == "host":
+                node.host_kv = (
+                    np.asarray(arrays[f"radix.{i}.k"]),
+                    np.asarray(arrays[f"radix.{i}.v"]),
+                )
+                node.blocks = []
+                self.host_blocks += key.shape[0] // self.block_size
+            else:
+                self.alloc.mark_cached(node.blocks)
+                self.device_blocks += len(node.blocks)
+            parent.children[int(key[0])] = node
+            order.append(node)
+            self._tick = max(self._tick, node.last_used)
+        c = snap.get("counters", {})
+        self.hit_tokens = int(c.get("hit_tokens", 0))
+        self.eligible_tokens = int(c.get("eligible_tokens", 0))
+        self.host_hit_tokens = int(c.get("host_hit_tokens", 0))

@@ -255,13 +255,19 @@ class ReplicatedServer:
 
     # ------------------------------------------------------------------ API
 
-    def _pick(self, covered: Optional[set] = None) -> PipelineServer:
+    def _pick(
+        self, covered: Optional[set] = None, prompt_ids=None,
+    ) -> PipelineServer:
         """Health-aware least-loaded routing: only SERVING replicas receive
         new traffic while at least one exists (a DEGRADED replica must not
         win least-loaded ties — it is the one most likely to fail the
         request); when none are SERVING, fall back in severity order to the
-        least-bad class. Least-loaded (queued + in-flight) within the
-        class; round-robin ties. ``covered`` restricts candidates (prefix
+        least-bad class. With per-replica prefix caches and a prompt, the
+        WARMEST replicas win first — each replica's radix tree is local,
+        so a request routed to the one holding its longest cached prefix
+        skips that much prefill (ties, and cold prompts, fall through to
+        load). Least-loaded (queued + in-flight) within the class;
+        round-robin ties. ``covered`` restricts candidates (prefix
         routing). Raises ``ServerClosed`` when no replica can take the
         request."""
         with self._lock:
@@ -284,6 +290,17 @@ class ReplicatedServer:
                 serving = [
                     s for s in cands if _HEALTH_SEVERITY[s.health] == best
                 ]
+            if prompt_ids is not None and any(
+                s._radix is not None for s in serving
+            ):
+                matches = {
+                    s: s.radix_match_tokens(prompt_ids) for s in serving
+                }
+                warmest = max(matches.values())
+                if warmest > 0:
+                    serving = [
+                        s for s in serving if matches[s] == warmest
+                    ]
             loads = {s: self._load(s) for s in serving}
             lo = min(loads.values())
             n = len(self.servers)
@@ -349,7 +366,12 @@ class ReplicatedServer:
                     "a bare PrefixHandle is bound to one replica's devices "
                     "— use ReplicatedServer.prefill_prefix"
                 )
-            s = self._pick(covered)
+            s = self._pick(
+                covered,
+                # prefix-cache-aware routing only applies to plain prompts
+                # (handle-bound suffixes carry their own shared KV)
+                prompt_ids=None if pfx is not None else prompt_ids,
+            )
             if covered is not None:
                 kw["prefix"] = pfx.per_server[s]
             req = s.submit(prompt_ids, max_new_tokens, **kw)
@@ -793,6 +815,12 @@ class ReplicatedServer:
                 if s.paged:
                     entry["kv_blocks_in_use"] = s._alloc.in_use
                     entry["kv_blocks_total"] = s._alloc.capacity_blocks
+                pc = s.prefix_cache_stats()
+                if pc is not None:
+                    # per-replica hit rate + host-tier occupancy: the radix
+                    # trees are replica-local, so the aggregate hides which
+                    # replica is warm
+                    entry["prefix_cache"] = pc
                 replicas.append(entry)
             return {
                 "counters": self.counters.snapshot(),

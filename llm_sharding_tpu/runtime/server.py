@@ -75,8 +75,8 @@ import jax.numpy as jnp
 
 from ..obs.metrics import (
     ATTN_BACKEND, ATTN_BACKENDS, ATTN_BLOCKS_READ, DEFAULT_RATE_BUCKETS,
-    KV_BLOCKS_IN_USE, KV_BLOCKS_TOTAL, KV_WASTE_FRAC, REGISTRY,
-    record_shape_key,
+    KV_BLOCKS_IN_USE, KV_BLOCKS_TOTAL, KV_HOST_TIER_BLOCKS, KV_WASTE_FRAC,
+    PREFIX_HIT_RATE, PREFIX_HIT_TOKENS, REGISTRY, record_shape_key,
 )
 from ..obs.trace import TraceWriter
 from ..parallel import serve as serve_ops
@@ -176,6 +176,7 @@ def _update_load_gauges() -> None:
     fragmentation the operator tunes ``kv_block_size`` against."""
     queued = active = 0
     kv_total = kv_used = kv_slots = kv_live = 0
+    host_blocks = hit_tok = elig_tok = 0
     backends = dict.fromkeys(ATTN_BACKENDS, 0)
     for s in list(_LIVE_SERVERS):
         queued += len(s._queue)
@@ -189,18 +190,31 @@ def _update_load_gauges() -> None:
         if getattr(s, "paged", False):
             kv_total += s._alloc.capacity_blocks
             kv_used += s._alloc.in_use
-            kv_slots += s._alloc.in_use * s.kv_block_size
+            # COLD prefix-cache blocks (tree-held, no row mapping them) are
+            # reusable capacity, not allocation: counting them in the waste
+            # denominator would misreport a healthy warm cache as leaked
+            # memory the moment traffic went quiet
+            kv_slots += (
+                s._alloc.in_use - s._alloc.cache_cold
+            ) * s.kv_block_size
             kv_live += sum(
                 int(s._mirror_len[i])
                 for i, r in enumerate(s._rows)
                 if r is not None and not r.done
             )
+            rad = getattr(s, "_radix", None)
+            if rad is not None:
+                host_blocks += rad.host_blocks
+                hit_tok += rad.hit_tokens
+                elig_tok += rad.eligible_tokens
     _M_QUEUE_DEPTH.set(queued)
     _M_ACTIVE.set(active)
     for b, n in backends.items():
         ATTN_BACKEND.labels(backend=b).set(n)
     KV_BLOCKS_TOTAL.set(kv_total)
     KV_BLOCKS_IN_USE.set(kv_used)
+    KV_HOST_TIER_BLOCKS.set(host_blocks)
+    PREFIX_HIT_RATE.set(hit_tok / elig_tok if elig_tok else 0.0)
     # shared prefix tokens count once per mapping row (mirror lengths are
     # prefix-inclusive) while their blocks are stored once — heavy sharing
     # can push live past slots, which simply reads as zero waste
@@ -472,6 +486,16 @@ def save_snapshot(snap: dict, path: str) -> None:
             "row_blocks": snap["paged"]["row_blocks"],
             "row_shared": snap["paged"]["row_shared"],
         }
+    radix_meta = None
+    if snap.get("radix") is not None:
+        # tree structure in the meta, edge keys + host-tier KV as arrays
+        # (host KV is cache-dtype — bf16 rides the same uint16-view tag)
+        for key, arr in snap["radix"]["arrays"].items():
+            put(key, arr)
+        radix_meta = {
+            "nodes": snap["radix"]["nodes"],
+            "counters": snap["radix"]["counters"],
+        }
 
     def enc_reqs(kind: str, reqs) -> list:
         out = []
@@ -499,6 +523,7 @@ def save_snapshot(snap: dict, path: str) -> None:
         "queue": enc_reqs("queue", snap["queue"]),
         "dtype_tags": dtags,
         "paged": paged_meta,
+        "radix": radix_meta,
     }
     np.savez(os.path.join(tmp, "state.npz"), **arrays)
     with open(os.path.join(tmp, "state.npz"), "rb") as f:
@@ -608,7 +633,17 @@ def load_snapshot(path: str) -> dict:
             "row_blocks": meta["paged"]["row_blocks"],
             "row_shared": meta["paged"]["row_shared"],
         }
+    radix = None
+    if meta.get("radix") is not None:
+        radix = {
+            "nodes": meta["radix"]["nodes"],
+            "counters": meta["radix"].get("counters", {}),
+            "arrays": {
+                k: v for k, v in arrays.items() if k.startswith("radix.")
+            },
+        }
     return {
+        "radix": radix,
         "format": meta["format"],
         "serve_kwargs": meta["serve_kwargs"],
         "state": state,
@@ -830,6 +865,8 @@ class PipelineServer:
         kv_block_size: Optional[int] = None,
         kv_blocks: Optional[int] = None,
         paged_attn: str = "auto",
+        prefix_cache: str = "off",
+        host_pool_blocks: int = 0,
     ):
         self.engine = engine
         self.cfg = engine.cfg
@@ -952,6 +989,47 @@ class PipelineServer:
         self.attn_impl = (
             self._resolve_attn_impl(paged_attn) if self.paged else "dense"
         )
+        # -- automatic prefix cache (runtime/radix.py) ---------------------
+        # "hbm": radix tree over token ids — every submit transparently
+        # reuses the longest cached prefix, finished rows' prompt blocks
+        # are indexed instead of freed, cold entries evict under allocator
+        # pressure. "host": additionally demotes cold blocks to a pinned
+        # host-RAM pool (device→host copy, streamed back bit-exact on a
+        # later hit) before dropping — HBM becomes a cache level, not a
+        # hard ceiling. Explicit PrefixHandles remain the manual/pinned
+        # escape hatch and bypass the tree entirely.
+        if prefix_cache not in ("off", "hbm", "host"):
+            raise ValueError(
+                f"prefix_cache must be off, hbm or host, got "
+                f"{prefix_cache!r}"
+            )
+        if prefix_cache != "off" and not self.paged:
+            raise ValueError(
+                "prefix_cache needs paged KV serving (set kv_block_size/"
+                "kv_blocks): the cache shares refcounted arena blocks — "
+                "dense per-row reservations have nothing to share"
+            )
+        if host_pool_blocks and prefix_cache != "host":
+            raise ValueError(
+                "host_pool_blocks sizes the host-RAM tier — it needs "
+                f"prefix_cache='host' (got prefix_cache={prefix_cache!r})"
+            )
+        if host_pool_blocks < 0:
+            raise ValueError(
+                f"host_pool_blocks must be >= 0, got {host_pool_blocks}"
+            )
+        if prefix_cache == "host" and jax.process_count() > 1:
+            raise ValueError(
+                "prefix_cache='host' moves block KV through host numpy — "
+                "unsupported on multi-controller meshes; use 'hbm'"
+            )
+        self.prefix_cache = prefix_cache
+        # host tier default: an arena-sized pool (the cache can spill
+        # everything it holds exactly once over)
+        self.host_pool_blocks = (
+            int(host_pool_blocks) if prefix_cache != "host"
+            else int(host_pool_blocks or kv_blocks)
+        )
         self._fault_plan = fault_plan
         if fault_retries < 0:
             raise ValueError(f"fault_retries must be >= 0, got {fault_retries}")
@@ -1032,6 +1110,24 @@ class PipelineServer:
             self._tables_dirty = False
         else:
             self._alloc = None
+        if self.prefix_cache != "off":
+            from .radix import RadixCache
+
+            self._radix: Optional["RadixCache"] = RadixCache(
+                self._alloc,
+                self.kv_block_size,
+                host_pool_blocks=(
+                    self.host_pool_blocks if self.prefix_cache == "host"
+                    else 0
+                ),
+                read_kv=self._read_arena_blocks,
+                write_kv=self._write_arena_blocks,
+            )
+        else:
+            self._radix = None
+        # per-row pinned radix match (RadixRef) — released with the row's
+        # blocks, whatever the outcome path
+        self._row_radix: list = [None] * M
         self._queue: collections.deque[Request] = collections.deque()
         self._rows: list[Optional[Request]] = [None] * M
         # HOST MIRRORS of the device bookkeeping, replayed from the per-chunk
@@ -1265,7 +1361,12 @@ class PipelineServer:
             # identical values, so sharing is race-free under the device's
             # program order. BlockExhausted propagates typed.
             with self._mutex:
-                blocks = self._alloc.alloc(spx // self.kv_block_size)
+                need = spx // self.kv_block_size
+                if self._radix is not None and need > self._alloc.num_free:
+                    # cold cached prefixes make way for an explicit
+                    # (pinned) handle — the operator asked for this one
+                    self._radix.ensure_free(need)
+                blocks = self._alloc.alloc(need)
                 self._handle_pins += len(blocks)
                 _update_load_gauges()
         logger.info(
@@ -1345,13 +1446,20 @@ class PipelineServer:
                     # padded-prefix column count: restore rebuilds the
                     # per-row cache-offset mirror (spec mode) from it
                     d["spx"] = r.prefix.spx
+                if r.row is not None and self._row_radix[r.row] is not None:
+                    # radix-hit rows admitted as (matched n, suffix): the
+                    # per-row cache-offset mirror and the re-pin both need n
+                    d["radix_n"] = int(self._row_radix[r.row].n)
                 return d
 
             return {
-                # format 2: adds the paged-KV section + kv serve kwargs
-                # (format-1 snapshots are dense by construction and still
-                # restore — see ``restore``)
-                "format": 2,
+                # format 3: adds the prefix-cache section (radix tree +
+                # host-tier KV) and its serve kwargs; formats 1 (dense) and
+                # 2 (paged, no cache) still restore — see ``restore``
+                "format": 3,
+                "radix": (
+                    None if self._radix is None else self._radix.snapshot()
+                ),
                 "serve_kwargs": dict(
                     capacity=self.capacity,
                     batch_per_slot=self.batch_per_slot,
@@ -1374,6 +1482,8 @@ class PipelineServer:
                     # a CPU mesh (pre-PR-6 snapshots lack the key and
                     # restore as "auto" via the constructor default)
                     paged_attn=self.paged_attn,
+                    prefix_cache=self.prefix_cache,
+                    host_pool_blocks=self.host_pool_blocks,
                 ),
                 # block ownership travels with the checkpoint: restore
                 # rebuilds the allocator's free list/refcounts from the
@@ -1410,7 +1520,7 @@ class PipelineServer:
         of an unsupported model family, raises the curated
         ``NotImplementedError`` instead of an obscure mesh/sharding error
         deep in the first dispatched program."""
-        if snap.get("format") not in (1, 2):
+        if snap.get("format") not in (1, 2, 3):
             raise ValueError(f"unknown snapshot format {snap.get('format')!r}")
         validate = getattr(engine, "_validate_serve", None)
         if validate is not None:
@@ -1537,6 +1647,15 @@ class PipelineServer:
         for d, r in zip(snap["rows"], srv._rows):
             if r is None:
                 continue
+            rn = int(d.get("radix_n") or 0)
+            if rn:
+                # radix-hit row: admitted as (matched n, suffix) — the
+                # delta derives from the SUFFIX bucket, not the full
+                # prompt's (prompt_len stayed prefix-inclusive)
+                srv._mirror_cachedelta[r.row] = (
+                    rn + srv._bucket(r.prompt_len - rn) - r.prompt_len
+                )
+                continue
             spx = d.get("spx", 0)
             # tokens[:baked] ride inside the (resumed) prompt, so only the
             # post-migration run counts toward the mirror beyond prompt_len
@@ -1556,7 +1675,43 @@ class PipelineServer:
             srv._row_shared = [
                 [int(x) for x in b] for b in pg["row_shared"]
             ]
-            srv._alloc.restore(srv._row_blocks, srv._row_shared)
+            rsnap = snap.get("radix")
+            # the radix tree's device-tier nodes are block OWNERS exactly
+            # like rows' private lists; host-tier nodes hold no device
+            # blocks. A snapshot carrying a tree restored into a server
+            # with the cache off DROPS it cleanly: the tree's blocks are
+            # simply never re-owned (rows still sharing them become the
+            # owners through the shared lists and free them on finish).
+            tree_owned = []
+            if srv._radix is not None and rsnap is not None:
+                tree_owned = [
+                    m["blocks"] for m in rsnap["nodes"] if m["tier"] == "hbm"
+                ]
+            elif rsnap is not None:
+                logger.warning(
+                    "snapshot carries a prefix-cache tree but this server "
+                    "has prefix_cache=off — dropping the cache (row-shared "
+                    "blocks free as their rows finish)"
+                )
+            srv._alloc.restore(
+                srv._row_blocks + tree_owned, srv._row_shared
+            )
+            if srv._radix is not None and rsnap is not None:
+                srv._radix.restore(rsnap, rsnap["arrays"])
+                # re-pin the restored rows' matches (refs are live-state,
+                # not snapshot state): every pinned path survived the
+                # snapshot because pinned nodes are never evicted
+                for d, r in zip(snap["rows"], srv._rows):
+                    rn = 0 if d is None or r is None else int(
+                        d.get("radix_n") or 0
+                    )
+                    if not rn:
+                        continue
+                    ref = srv._radix.take(r.prompt[:rn], rn)
+                    if ref is not None and ref.n == rn:
+                        srv._row_radix[r.row] = ref
+                    elif ref is not None:
+                        srv._radix.release(ref)
         srv._m = snap["m"]
         srv._sampling = snap["sampling"]
         srv._filtering = snap["filtering"]
@@ -1927,7 +2082,9 @@ class PipelineServer:
             req.done = True
             req.finished_at = time.perf_counter()
             self._rows[req.row] = None
-            self._release_row_blocks(req.row)
+            # a cancelled row's PROMPT KV is complete (admission finished
+            # before anything could cancel it) — index it like a finish
+            self._release_row_blocks(req.row, req=req, insert=True)
             self.counters.inc("requests_cancelled")
             _update_load_gauges()
         logger.info("cancel id=%d row=%d tokens=%d", req.id, req.row,
@@ -2112,28 +2269,32 @@ class PipelineServer:
 
     def _map_row_blocks(
         self, row: int, bucket: int, max_new: int,
-        pfx: Optional["PrefixHandle"], chunked: bool,
+        spx: int, shared_blocks, chunked: bool,
     ) -> None:
         """Allocate a row's private blocks and build its table: shared
-        prefix blocks first (read-only, refcounted), private blocks through
-        the budget, trash everywhere else. The caller checked ``num_free``
-        before popping the request, so the alloc cannot fail here."""
+        prefix blocks first (read-only, refcounted — a PrefixHandle's or a
+        radix match's), private blocks through the budget, trash
+        everywhere else. The caller checked free-or-evictable headroom
+        before popping the request; with the prefix cache on, cold tree
+        blocks are evicted here to honor that promise."""
         bs = self.kv_block_size
-        spx = 0 if pfx is None else pfx.spx
         n_pfx = spx // bs
-        priv = self._alloc.alloc(
-            self._blocks_needed(bucket, max_new, spx, chunked)
-        )
+        need = self._blocks_needed(bucket, max_new, spx, chunked)
+        if self._radix is not None and need > self._alloc.num_free:
+            self._radix.ensure_free(need)
+        priv = self._alloc.alloc(need)
         self._row_blocks[row] = priv
         tbl = self._tables[row]
         tbl[:] = 0
-        if pfx is not None:
-            self._alloc.share(pfx.blocks)
-            self._row_shared[row] = list(pfx.blocks)
-            tbl[:n_pfx] = pfx.blocks
+        if shared_blocks:
+            self._alloc.share(shared_blocks)
+            self._row_shared[row] = list(shared_blocks)
+            tbl[:n_pfx] = shared_blocks
         tbl[n_pfx : n_pfx + len(priv)] = priv
 
-    def _release_row_blocks(self, row: int) -> None:
+    def _release_row_blocks(
+        self, row: int, req: Optional[Request] = None, insert: bool = False,
+    ) -> None:
         """Free a finished/cancelled/failed row's KV blocks. The host table
         row is remapped to the trash block immediately; the DEVICE push is
         deferred (``_tables_dirty``) and coalesced — a batch of co-admitted
@@ -2142,20 +2303,57 @@ class PipelineServer:
         ``_map_row_blocks``/``prefill_prefix``, and every KV-touching
         program dispatch flushes the mirror first (``_flush_tables`` /
         the admission push) — so by the time any program could write the
-        recycled block, the old row's device table already says trash."""
+        recycled block, the old row's device table already says trash.
+
+        With the prefix cache on and ``insert=True`` (clean finish /
+        explicit cancel — paths where the prompt region's KV is known
+        complete), the blocks covering the block-aligned prompt prefix are
+        INSERTED into the radix tree instead of freed: their allocator
+        reference transfers to the tree, the content is final (decode and
+        spec-scratch writes land strictly past the prompt region, and a
+        done row's writes are entry-gated off), and the next request
+        sharing the prefix maps them copy-free. Failure paths
+        (containment, deadline, shutdown) release without inserting."""
         if not self.paged:
             return
         priv, shared = self._row_blocks[row], self._row_shared[row]
+        rref = self._row_radix[row]
+        self._row_radix[row] = None
         if not priv and not shared:
+            if rref is not None:
+                self._radix.release(rref)
             return
+        consumed: set = set()
+        if (
+            insert and self._radix is not None and req is not None
+            and req.embeds is None and req.prefix is None
+        ):
+            bs = self.kv_block_size
+            plen = req.prompt_len
+            # a chunk-admitted row's FINAL prompt token rides the injection
+            # path — its KV lands past the bucket region, so the contiguous
+            # cacheable run ends one token early there
+            chunked = (
+                rref is None and self.prefill_chunk is not None
+                and self._chunked(self._bucket(plen))
+            )
+            nb = (plen - (1 if chunked else 0)) // bs
+            cand = [int(b) for b in self._tables[row][:nb]]
+            if nb > 0 and 0 not in cand:
+                consumed = self._radix.insert(
+                    np.asarray(req.prompt[: nb * bs], np.int32), cand
+                )
         self._row_blocks[row] = []
         self._row_shared[row] = []
         self._tables[row] = 0
         self._tables_dirty = True
-        if priv:
-            self._alloc.free(priv)
+        rel_priv = [b for b in priv if b not in consumed] if consumed else priv
+        if rel_priv:
+            self._alloc.free(rel_priv)
         if shared:
             self._alloc.free(shared)
+        if rref is not None:
+            self._radix.release(rref)
 
     def _push_tables(self) -> None:
         """Ship the host block-table mirror to the device state (replicated
@@ -2172,6 +2370,91 @@ class PipelineServer:
         """Push deferred release remaps before a program dispatch."""
         if self.paged and self._tables_dirty:
             self._push_tables()
+
+    # ------------------------------------ automatic prefix cache internals
+
+    def _read_arena_blocks(self, blocks) -> tuple:
+        """Device→host copy of arena blocks (radix host-tier demotion).
+        Returns (k, v) numpy ``[S, Lp, nb, BS, Nkv, Dh]`` in the cache
+        dtype — the exact bytes ``_write_arena_blocks`` later restores."""
+        idx = jnp.asarray(np.asarray(list(blocks), np.int32))
+        k = np.asarray(jnp.take(self.state.k, idx, axis=2))
+        v = np.asarray(jnp.take(self.state.v, idx, axis=2))
+        return k, v
+
+    def _write_arena_blocks(self, blocks, k_host, v_host) -> None:
+        """Host→device restore of demoted blocks into freshly allocated
+        arena slots (donating scatter — the arena never transiently
+        doubles). Dispatch order makes it safe: the write precedes any
+        program that could attend the restored blocks."""
+        idx = jnp.asarray(np.asarray(list(blocks), np.int32))
+        k_new, v_new = serve_ops.write_arena_blocks(
+            self.state.k, self.state.v, idx,
+            jnp.asarray(k_host), jnp.asarray(v_host),
+        )
+        self.state = self.state._replace(k=k_new, v=v_new)
+
+    def radix_match_tokens(self, prompt_ids) -> int:
+        """How many leading tokens of ``prompt_ids`` this server's prefix
+        cache currently holds (0 with the cache off) — the routing signal
+        ``ReplicatedServer._pick`` uses to prefer the warmest replica."""
+        if self._radix is None:
+            return 0
+        with self._mutex:
+            return self._radix.match_tokens(
+                np.asarray(prompt_ids, np.int32).reshape(-1)
+            )
+
+    def prefix_cache_stats(self) -> Optional[dict]:
+        """Hit-rate and tier-occupancy snapshot for ``:stats`` /
+        ``ReplicatedServer.stats()``; None with the cache off."""
+        if self._radix is None:
+            return None
+        with self._mutex:
+            return self._radix.stats()
+
+    def _radix_plan(self, req: Request):
+        """The longest USABLE cached prefix for a queued request, taken
+        (pinned, host nodes streamed back) as a ``RadixRef`` — or None
+        (cold admission). Usable means: block-aligned, leaves at least one
+        suffix token (the first output samples from the suffix's last
+        position), and the prefix-row layout ``n + bucket(suffix) +
+        max_new`` fits capacity and the position budget WITHOUT chunked
+        admission (prefix admissions are one-shot; a hit shrinks the
+        suffix, so the cold chunked path only wins when there is nothing
+        to reuse)."""
+        if (
+            self._radix is None or req.prefix is not None
+            or req.embeds is not None
+        ):
+            return None
+        plen = req.prompt_len
+        bs = self.kv_block_size
+        m = self._radix.match_tokens(req.prompt)
+        m = min(m, ((plen - 1) // bs) * bs)
+
+        def usable(n: int) -> bool:
+            bucket = self._bucket(plen - n)
+            total = n + bucket + req.max_new
+            return (
+                not self._chunked(bucket)
+                and total <= self.capacity
+                and total <= self.cfg.max_position_embeddings
+            )
+
+        while m > 0 and not usable(m):
+            m -= bs
+        if m <= 0:
+            return None
+        ref = self._radix.take(req.prompt, m)
+        if ref is None:
+            return None
+        if ref.n != m and not usable(ref.n):
+            # a host-tier node on the path could not stream back and the
+            # truncated match no longer lays out — admit cold
+            self._radix.release(ref)
+            return None
+        return ref
 
     def release_prefix(self, handle: "PrefixHandle") -> None:
         """Drop a paged ``prefill_prefix`` handle's own block references.
@@ -2247,7 +2530,11 @@ class PipelineServer:
                         req.row,
                     )
                 self._rows[req.row] = None
-                self._release_row_blocks(req.row)
+                # a migrating row's prompt KV is as complete as a
+                # cancelled one's — index it so later same-prefix traffic
+                # routed back here stays warm (on a dead replica the tree
+                # dies with the server; inserting is still harmless)
+                self._release_row_blocks(req.row, req=req, insert=True)
                 self._mirror_len[req.row] = 0
                 self._mirror_budget[req.row] = 0
                 self._mirror_cachedelta[req.row] = 0
@@ -2728,6 +3015,22 @@ class PipelineServer:
                 break
             t_admit0 = time.perf_counter()
             Bs = self.batch_per_slot
+            head = self._queue[0]
+            # embeds requests co-admit only with embeds requests: the two
+            # entries are different compiled admission programs. Prefix
+            # requests co-admit only with the SAME handle — the slot's cache
+            # rows are all seeded from one prefix KV.
+            is_emb = head.embeds is not None
+            pfx = head.prefix
+            # automatic prefix cache: the head's longest usable cached
+            # prefix (pinned; host-tier nodes streamed back). The request
+            # then admits through the PREFIX path — only its suffix
+            # prefills, at absolute positions n + i — with the matched
+            # blocks mapped read-only into the row's table. req.prompt
+            # stays the FULL prompt (migration/spec-drafting/snapshot all
+            # read it), the split below is admission-local.
+            rplan = self._radix_plan(head)
+            spx_n = 0 if rplan is None else rplan.n
             # Co-admit only same-bucket requests: submit() validated each
             # request's capacity needs against ITS OWN bucket, and admission
             # runs at the batch bucket — a shorter request lumped under a
@@ -2735,43 +3038,67 @@ class PipelineServer:
             # offset and could silently overflow the cache (the
             # dynamic-update-slice clamp corrupts the last slot, no error).
             # FIFO stays honest: we take the longest same-bucket prefix.
-            bucket = self._bucket(self._queue[0].prompt_len)
-            # embeds requests co-admit only with embeds requests: the two
-            # entries are different compiled admission programs. Prefix
-            # requests co-admit only with the SAME handle — the slot's cache
-            # rows are all seeded from one prefix KV.
-            is_emb = self._queue[0].embeds is not None
-            pfx = self._queue[0].prefix
-            chunked = not is_emb and pfx is None and self._chunked(bucket)
+            # Radix batches additionally require the SAME matched token
+            # prefix — every row's table maps the same shared blocks, like
+            # the one-handle rule (the common case IS shared traffic: N
+            # requests over one system prompt).
+            bucket = self._bucket(head.prompt_len - spx_n)
+            chunked = (
+                not is_emb and pfx is None and rplan is None
+                and self._chunked(bucket)
+            )
+            spx = pfx.spx if pfx is not None else spx_n
 
             def fits(r: Request, free_left: int) -> tuple[bool, int]:
                 """Paged admission gate: a request admits only if its
-                private blocks fit the pool RIGHT NOW. Exhaustion is a
-                queue wait (FIFO preserved — head-of-line blocks the
-                admission wave), never a crash."""
+                private blocks fit the pool RIGHT NOW — where "free"
+                includes cold prefix-cache blocks the tree can evict on
+                demand. Exhaustion is a queue wait (FIFO preserved —
+                head-of-line blocks the admission wave), never a crash."""
                 if not self.paged:
                     return True, free_left
-                need = self._blocks_needed(
-                    bucket, r.max_new,
-                    0 if pfx is None else pfx.spx, chunked,
-                )
+                need = self._blocks_needed(bucket, r.max_new, spx, chunked)
                 return need <= free_left, free_left - need
 
-            free_left = self._alloc.num_free if self.paged else 0
-            ok, free_left = fits(self._queue[0], free_left)
+            free_left = (
+                self._alloc.num_free
+                + (self._radix.evictable_blocks() if self._radix else 0)
+            ) if self.paged else 0
+            ok, free_left = fits(head, free_left)
             if not ok:
+                if rplan is not None:
+                    self._radix.release(rplan)
                 logger.info(
                     "admission waits: request %d needs more KV blocks than "
-                    "the %d free", self._queue[0].id, self._alloc.num_free,
+                    "the %d free", head.id, self._alloc.num_free,
                 )
                 break
+
+            def co_admits(r: Request) -> bool:
+                if (r.embeds is not None) != is_emb or r.prefix is not pfx:
+                    return False
+                if rplan is None:
+                    return self._bucket(r.prompt_len) == bucket
+                # the prefix-row LAYOUT must fit for THIS request too:
+                # submit validated against the full-prompt bucket, which
+                # can be SMALLER than spx + suffix bucket at small block
+                # sizes — usable() only vetted the head's max_new
+                total = spx_n + bucket + r.max_new
+                return (
+                    r.prompt_len > spx_n
+                    and self._bucket(r.prompt_len - spx_n) == bucket
+                    and total <= self.capacity
+                    and total <= self.cfg.max_position_embeddings
+                    and bool(np.array_equal(
+                        r.prompt[:spx_n], head.prompt[:spx_n]
+                    ))
+                )
+
             batch: list[Request] = [self._queue.popleft()]
             while (
                 len(batch) < Bs
                 and self._queue
-                and self._bucket(self._queue[0].prompt_len) == bucket
-                and (self._queue[0].embeds is not None) == is_emb
-                and self._queue[0].prefix is pfx
+                and co_admits(self._queue[0])
             ):
                 ok, free_left = fits(self._queue[0], free_left)
                 if not ok:
@@ -2794,11 +3121,14 @@ class PipelineServer:
             rngs = np.zeros((Bs, 2), np.uint32)
             rng_mask = np.zeros((Bs,), bool)
             for i, r in enumerate(batch):
+                # with a radix match the device sees only the SUFFIX (the
+                # matched prefix's KV is already in the mapped blocks)
+                sfx_len = r.prompt_len - spx_n
                 if is_emb:
                     embeds[i, : r.prompt_len] = r.embeds
                 else:
-                    prompts[i, : r.prompt_len] = r.prompt
-                plen[i] = r.prompt_len
+                    prompts[i, :sfx_len] = r.prompt[spx_n:]
+                plen[i] = sfx_len
                 row_valid[i] = True
                 max_new[i] = r.max_new
                 seeds[i] = r.seed
@@ -2815,19 +3145,37 @@ class PipelineServer:
                 self._rows[r.row] = r
                 # mirrors track TOTAL (prefix-inclusive) lengths — they
                 # replay the device's absolute-position bookkeeping
-                pfx_n = 0 if pfx is None else pfx.n
-                self._mirror_len[r.row] = pfx_n + r.prompt_len
-                self._mirror_budget[r.row] = pfx_n + r.prompt_len + r.max_new
+                pfx_n = pfx.n if pfx is not None else spx_n
+                self._mirror_len[r.row] = pfx_n + sfx_len
+                self._mirror_budget[r.row] = pfx_n + sfx_len + r.max_new
                 # spec mode: the pending token's KV lands right after the
                 # admission bucket (plus any padded-prefix columns); its
-                # position is pfx_n + prompt_len — the difference is the
+                # position is pfx_n + suffix length — the difference is the
                 # row's constant slot−position delta
                 self._mirror_cachedelta[r.row] = (
-                    (0 if pfx is None else pfx.spx) + bucket
-                    - (pfx_n + r.prompt_len)
+                    spx + bucket - (pfx_n + sfx_len)
                 )
                 if self.paged:
-                    self._map_row_blocks(r.row, bucket, r.max_new, pfx, chunked)
+                    self._map_row_blocks(
+                        r.row, bucket, r.max_new, spx,
+                        pfx.blocks if pfx is not None
+                        else (rplan.blocks if rplan is not None else None),
+                        chunked,
+                    )
+                    if rplan is not None:
+                        # one pin per mapping row (the take() pin covers
+                        # the first row; later rows add their own)
+                        if i > 0:
+                            self._radix.pin(rplan)
+                        self._row_radix[r.row] = rplan
+                if self._radix is not None and pfx is None and not is_emb:
+                    # hit accounting: cache-served vs cache-eligible prompt
+                    # tokens (requests with an explicit handle or an
+                    # embeddings entry never consult the tree)
+                    self._radix.eligible_tokens += r.prompt_len
+                    if spx_n:
+                        self._radix.hit_tokens += spx_n
+                        PREFIX_HIT_TOKENS.inc(spx_n)
             if self.paged:
                 # tables must be on device BEFORE the admission dispatch —
                 # its scatter initializes exactly the blocks just mapped
@@ -2836,23 +3184,42 @@ class PipelineServer:
 
             def do_admit(
                 slot=slot, bucket=bucket, batch=batch, is_emb=is_emb,
-                pfx=pfx, prompts=prompts, embeds=embeds, plen=plen,
-                row_valid=row_valid, max_new=max_new, seeds=seeds,
-                temps=temps, topks=topks, topps=topps, rngs=rngs,
-                rng_mask=rng_mask,
+                pfx=pfx, rplan=rplan, spx_n=spx_n, prompts=prompts,
+                embeds=embeds, plen=plen, row_valid=row_valid,
+                max_new=max_new, seeds=seeds, temps=temps, topks=topks,
+                topps=topps, rngs=rngs, rng_mask=rng_mask,
             ):
                 self._fault_check("admit_dispatch")
                 carried = bool(rng_mask.any())
-                if not is_emb and pfx is None and self._chunked(bucket):
+                if (
+                    not is_emb and pfx is None and rplan is None
+                    and self._chunked(bucket)
+                ):
                     self._admit_chunked(
                         slot, prompts, plen, row_valid, max_new, seeds,
                         temps, topks, topps, rngs, rng_mask,
                     )
                     return
+                if pfx is not None:
+                    pkv, pn, spx_key = pfx.kv, pfx.n, pfx.spx
+                elif rplan is not None:
+                    # radix hit: the prefix KV is ALREADY in the arena —
+                    # assemble the serve_admit prefix operand by gathering
+                    # the matched blocks (zero prefill FLOPs; the admission
+                    # re-scatters the identical bytes through the new rows'
+                    # tables, race-free for concurrent readers)
+                    pkv = serve_ops.gather_prefix_kv(
+                        self.mesh, self.state.k, self.state.v,
+                        jnp.asarray(np.asarray(rplan.blocks, np.int32)),
+                        self.kv_block_size, tp=self.tp,
+                    )
+                    pn, spx_key = spx_n, spx_n
+                else:
+                    pkv, pn, spx_key = None, None, None
                 record_shape_key(
                     "serve_admit",
                     (self.num_stages, Bs, self.capacity, bucket, is_emb,
-                     None if pfx is None else pfx.spx, self._filtering,
+                     spx_key, self._filtering,
                      self.tp, self.kv_block_size, carried),
                 )
                 self.state, tok0 = serve_ops.serve_admit(
@@ -2877,9 +3244,9 @@ class PipelineServer:
                         None if embeds is None else jnp.asarray(embeds)
                     ),
                     filtering=self._filtering,
-                    prefix_kv=None if pfx is None else pfx.kv,
+                    prefix_kv=pkv,
                     prefix_len=(
-                        None if pfx is None else jnp.asarray(pfx.n, jnp.int32)
+                        None if pn is None else jnp.asarray(pn, jnp.int32)
                     ),
                     key_override=(
                         (jnp.asarray(rngs), jnp.asarray(rng_mask))
@@ -3255,7 +3622,7 @@ class PipelineServer:
             req.done = True
             req.finished_at = time.perf_counter()
             self._rows[row] = None  # slot row becomes reusable
-            self._release_row_blocks(row)
+            self._release_row_blocks(row, req=req, insert=True)
             self.counters.inc("requests_completed")
             dur = req.finished_at - (req.started_at or req.finished_at)
             queue_wait = (

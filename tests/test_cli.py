@@ -240,6 +240,30 @@ def test_serve_paged_cli(shards, capsys, monkeypatch):
     dense = run([])
     paged = run(["--kv-block-size", "16", "--kv-blocks", "40"])
     assert paged == dense and len(paged) == 2
+    # automatic prefix caching rides the same daemon, output unchanged
+    # (the second prompt shares no prefix — pure cold-path parity here)
+    radix = run([
+        "--kv-block-size", "16", "--kv-blocks", "40",
+        "--prefix-cache", "hbm",
+    ])
+    assert radix == dense
+
+
+def test_serve_prefix_cache_flag_fast_fails(shards, capsys):
+    """--prefix-cache without paged KV flags, and --host-pool-blocks
+    without --prefix-cache host, fail in milliseconds — before model
+    load (same pre-load pattern as the kv flag pairing)."""
+    rc = cli.main(["serve", shards, "--prefix-cache", "hbm"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "--prefix-cache" in err and "--kv-block-size" in err
+    rc = cli.main([
+        "serve", shards, "--kv-block-size", "16", "--kv-blocks", "40",
+        "--prefix-cache", "hbm", "--host-pool-blocks", "8",
+    ])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "--host-pool-blocks" in err and "host" in err
 
 
 def test_serve_speculate_cli(shards, capsys, monkeypatch):

@@ -465,6 +465,48 @@ def test_completion_roundtrip_token_exact(backend, params):
         ing.stop()
 
 
+def test_embeddings_roundtrip_token_exact(backend, params):
+    """POST /v1/embeddings (ROADMAP item 5 leftover): the privacy entry
+    over HTTP — 'input' carries [S, H] prompt hidden states, the response
+    is an ordinary completion, token-identical to submitting the ids, and
+    the request rides the same fair queue + ingress counters."""
+    from llm_sharding_tpu.obs.metrics import INGRESS_REQUESTS
+
+    ing = make_ingress(backend)
+    try:
+        p = prompt(107)
+        want = oracle(params, p, 6)
+        emb = np.asarray(
+            backend.embed_prompt(p)[0]
+            if hasattr(backend, "embed_prompt")
+            else backend.engine.embed_prompt(p)[0],
+            np.float32,
+        )
+        ok0 = INGRESS_REQUESTS.labels(tenant="default", outcome="ok").value
+        status, headers, body = post(
+            ing.port, {"input": emb.tolist(), "max_tokens": 6},
+            path="/v1/embeddings",
+        )
+        assert status == 200
+        assert body["choices"][0]["token_ids"] == want
+        assert body["usage"]["prompt_tokens"] == len(p)
+        assert headers["X-Request-Id"] == body["id"]
+        assert (
+            INGRESS_REQUESTS.labels(tenant="default", outcome="ok").value
+            == ok0 + 1
+        )
+        # malformed input is a 400, not a handler crash
+        status, _, body = post(
+            ing.port, {"input": [1.0, 2.0]}, path="/v1/embeddings",
+        )
+        assert status == 400, body
+        status, _, _ = post(ing.port, {"max_tokens": 4}, path="/v1/embeddings")
+        assert status == 400
+    finally:
+        ing.stop()
+    assert_allocators_drained(backend)
+
+
 def test_sse_stream_token_exact(backend, params):
     """stream=true: SSE events carry the token ids incrementally, the
     final event has finish_reason + usage, and the stream terminates with
