@@ -995,6 +995,136 @@ def bench_paged_kernel_serve(on_tpu, engine):
     )
 
 
+def bench_kv_quant_serve(on_tpu, engine):
+    """Quantized KV arena (ISSUE 11, --kv-dtype int8): the SAME skewed
+    serve workload on a bf16 arena vs an int8 arena sized to the SAME HBM
+    byte budget. Int8 blocks are ~half the bytes (1-byte codes + the
+    per-block-per-head f32 scale arenas), so the equal-budget arena admits
+    ~2× the blocks — which is also 2× the radix-cache and host-tier
+    capacity — and the decode kernel's per-block DMA moves half the
+    attention bytes. This is the FIRST intentionally non-bit-exact serve
+    variant, so the drift-tolerance harness rides in-band: greedy
+    token-match fraction int8-vs-bf16 over the whole request list (same
+    shape as the prefix bench's ``token_match_frac``), asserted >= 0.95 on
+    the chip workload. The capacity doubling (>= 1.9× blocks at equal
+    bytes, via ``BlockAllocator.bytes_per_block``) is asserted on every
+    platform — it is arithmetic, not weather. Emits int8 tok/s (the
+    metric), the bf16 figure, blocks-at-equal-HBM for both dtypes, the
+    max concurrent rows each run reached, arena bytes, and the match
+    fraction."""
+    from llm_sharding_tpu.parallel.mesh import PIPE_AXIS
+
+    name = (
+        "serve_tok_s_kv8_llama3.2-3b_1stage" if on_tpu
+        else "serve_tok_s_kv8_tiny_cpu"
+    )
+    cfg = engine.cfg
+    if on_tpu:
+        rows_bf16, capacity, chunk_cycles, depth = 16, 320, 8, 2
+        rows_int8, block = 32, 64
+        prompt_len, short_new, long_new, long_every = 32, 32, 256, 6
+        n_requests = 64
+    else:
+        rows_bf16, capacity, chunk_cycles, depth = 2, 64, 2, 1
+        rows_int8, block = 4, 16
+        prompt_len, short_new, long_new, long_every = 8, 8, 40, 4
+        n_requests = 8
+    n_slots = engine.mesh.shape[PIPE_AXIS]
+    Lp = engine.layer_masks.shape[1]
+    # equal HBM budget in BYTES: what the bf16 arena of the paged bench's
+    # sizing costs; each dtype admits budget // bytes_per_block blocks
+    from llm_sharding_tpu.runtime.blocks import BlockAllocator
+
+    probe = BlockAllocator(2, block)
+    per_block = {
+        kd: probe.bytes_per_block(
+            num_layers=n_slots * Lp,
+            num_kv_heads=cfg.num_key_value_heads,
+            head_dim=cfg.head_dim_,
+            kv_dtype={"bf16": engine.cache_dtype, "int8": np.int8}[kd],
+        )
+        for kd in ("bf16", "int8")
+    }
+    budget_bytes = (
+        (n_slots * rows_bf16 * capacity // block) * per_block["bf16"]
+    )
+    blocks_at_budget = {
+        kd: budget_bytes // per_block[kd] for kd in per_block
+    }
+    ratio = blocks_at_budget["int8"] / blocks_at_budget["bf16"]
+    if ratio < 1.9:
+        # the capacity-doubling acceptance bar — pure arithmetic, asserted
+        # on every platform (scale overhead grows toward small blocks ×
+        # many heads; 1.9 bounds it at serving shapes)
+        raise RuntimeError(
+            f"int8 arena admits only {ratio:.2f}x the bf16 blocks at "
+            f"equal HBM ({blocks_at_budget})"
+        )
+    rng = np.random.default_rng(13)
+    workload = [
+        (
+            rng.integers(0, cfg.vocab_size, prompt_len).astype(np.int32),
+            long_new if i % long_every == long_every - 1 else short_new,
+        )
+        for i in range(n_requests)
+    ]
+
+    def run(kv_dtype):
+        srv = engine.serve(
+            capacity=capacity,
+            batch_per_slot=rows_int8 if kv_dtype == "int8" else rows_bf16,
+            chunk_cycles=chunk_cycles, pipeline_depth=depth,
+            kv_block_size=block,
+            kv_blocks=int(blocks_at_budget[kv_dtype]) + 1,  # +1: trash
+            kv_dtype=kv_dtype,
+        )
+        arena_bytes = srv.arena_bytes_device
+        reqs = [srv.submit(p, max_new_tokens=n) for p, n in workload]
+        max_rows = 0
+        t0 = time.perf_counter()
+        while any(not r.done for r in reqs):
+            srv.step()
+            max_rows = max(
+                max_rows,
+                sum(r is not None and not r.done for r in srv._rows),
+            )
+        dt = time.perf_counter() - t0
+        toks = [list(r.tokens) for r in reqs]
+        tok_s = sum(len(t) for t in toks) / dt
+        srv.close()
+        del srv
+        gc.collect()
+        return tok_s, max_rows, toks, arena_bytes
+
+    run("bf16")  # compile at this shape
+    bf16_tok_s, bf16_max, bf16_toks, bf16_bytes = run("bf16")
+    run("int8")
+    int8_tok_s, int8_max, int8_toks, int8_bytes = run("int8")
+    match = [
+        sum(a == b for a, b in zip(d, p)) / max(len(d), 1)
+        for d, p in zip(bf16_toks, int8_toks)
+    ]
+    match_frac = sum(match) / len(match)
+    if on_tpu and match_frac < 0.95:
+        # the drift-tolerance quality gate (greedy token-match fraction on
+        # the bench prompts) — a kv8 throughput win below it is not a win
+        raise RuntimeError(
+            f"int8 KV greedy token-match {match_frac:.3f} < 0.95 vs bf16"
+        )
+    emit(
+        name, int8_tok_s, "tokens/sec", int8_tok_s / ANCHOR_TOK_S,
+        bf16_tok_s=round(bf16_tok_s, 2),
+        kv_block_size=block,
+        hbm_budget_bytes=int(budget_bytes),
+        blocks_bf16=int(blocks_at_budget["bf16"]),
+        blocks_int8=int(blocks_at_budget["int8"]),
+        blocks_ratio=round(ratio, 3),
+        rows_max_bf16=bf16_max, rows_max_int8=int8_max,
+        arena_bytes_bf16=int(bf16_bytes), arena_bytes_int8=int(int8_bytes),
+        token_match_frac=round(match_frac, 3),
+    )
+
+
 def bench_radix_serve(on_tpu, engine):
     """Automatic prefix caching (ISSUE 10, runtime/radix.py) on the
     workload it exists for: MULTI-TURN CHAT over a shared system prompt.
@@ -1405,6 +1535,10 @@ def main():
         "serve_tok_s_radix_llama3.2-3b_1stage" if on_tpu
         else "serve_tok_s_radix_tiny_cpu"
     )
+    nkv8 = (
+        "serve_tok_s_kv8_llama3.2-3b_1stage" if on_tpu
+        else "serve_tok_s_kv8_tiny_cpu"
+    )
     noverload = (
         "serve_overload_goodput_llama3.2-3b_1stage" if on_tpu
         else "serve_overload_goodput_tiny_cpu"
@@ -1488,6 +1622,19 @@ def main():
                 bench_radix_serve(on_tpu, serve_engine)
             except Exception as e:  # noqa: BLE001
                 emit_error(nradix, "tokens/sec", e)
+        # quantized KV arena (int8 codes + fused dequant): equal-HBM
+        # capacity doubling + the drift-tolerance quality gate, on the
+        # same live engine
+        if serve_engine is None:
+            emit_error(nkv8, "tokens/sec",
+                       "not attempted: serve engine unavailable")
+        elif remaining() < 240:
+            emit_skip(nkv8, "tokens/sec", 240)
+        else:
+            try:
+                bench_kv_quant_serve(on_tpu, serve_engine)
+            except Exception as e:  # noqa: BLE001
+                emit_error(nkv8, "tokens/sec", e)
         # fault-injection serve (robustness overhead) reuses the serve
         # engine before it is torn down
         if serve_engine is None:

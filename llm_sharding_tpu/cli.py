@@ -271,6 +271,7 @@ def _serve_control(eng, srv, line: str, args):
                 snapshot_path=srv._snapshot_path,
                 kv_block_size=srv.kv_block_size,
                 kv_blocks=srv.kv_blocks,
+                kv_dtype=srv.kv_dtype,
                 paged_attn=srv.paged_attn,
                 prefix_cache=srv.prefix_cache,
                 host_pool_blocks=(
@@ -418,6 +419,14 @@ def cmd_serve(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if getattr(args, "kv_dtype", "bf16") != "bf16" and not args.kv_block_size:
+        print(
+            f"error: --kv-dtype {args.kv_dtype} needs paged KV serving "
+            "(--kv-block-size/--kv-blocks); quantization scales live per "
+            "arena block",
+            file=sys.stderr,
+        )
+        return 2
     if getattr(args, "prefix_cache", "off") != "off" and not args.kv_block_size:
         print(
             f"error: --prefix-cache {args.prefix_cache} needs paged KV "
@@ -505,6 +514,7 @@ def cmd_serve(args) -> int:
             snapshot_path=args.snapshot_dir,
             kv_block_size=args.kv_block_size or None,
             kv_blocks=args.kv_blocks or None,
+            kv_dtype=getattr(args, "kv_dtype", "bf16"),
             paged_attn=getattr(args, "paged_attn", "auto"),
             prefix_cache=getattr(args, "prefix_cache", "off"),
             host_pool_blocks=getattr(args, "host_pool_blocks", 0),
@@ -568,6 +578,8 @@ def cmd_serve(args) -> int:
                     ("kv_block_size", args.kv_block_size or None,
                      srv.kv_block_size),
                     ("kv_blocks", args.kv_blocks or None, srv.kv_blocks),
+                    ("kv_dtype", getattr(args, "kv_dtype", "bf16"),
+                     srv.kv_dtype),
                     ("paged_attn", getattr(args, "paged_attn", "auto"),
                      srv.paged_attn),
                     ("prefix_cache", getattr(args, "prefix_cache", "off"),
@@ -609,6 +621,7 @@ def cmd_serve(args) -> int:
                 snapshot_path=args.snapshot_dir,
                 kv_block_size=args.kv_block_size or None,
                 kv_blocks=args.kv_blocks or None,
+                kv_dtype=getattr(args, "kv_dtype", "bf16"),
                 paged_attn=getattr(args, "paged_attn", "auto"),
                 prefix_cache=getattr(args, "prefix_cache", "off"),
                 host_pool_blocks=getattr(args, "host_pool_blocks", 0),
@@ -1222,6 +1235,20 @@ def build_parser() -> argparse.ArgumentParser:
         "reserved trash sink). KV HBM per stage is roughly kv-blocks x "
         "kv-block-size x Nkv x Dh x 2 x dtype-bytes x layers-per-stage; "
         "admission waits in queue when free blocks run out",
+    )
+    s.add_argument(
+        "--kv-dtype", choices=("bf16", "int8", "fp8"), default="bf16",
+        dest="kv_dtype",
+        help="paged KV arena storage dtype (with --kv-block-size/"
+        "--kv-blocks): bf16 = store in the compute cache dtype (exact, "
+        "the default); int8/fp8 = 1-byte codes with per-block-per-head "
+        "scales, dequantized inside the paged-attention kernel's "
+        "per-block DMA loop — ~2x the arena blocks at equal HBM (and 2x "
+        "the radix/host-tier capacity) and half the decode-attention "
+        "bandwidth, at a small bounded greedy-token drift (gate rollouts "
+        "on bench's kv-quant token-match fraction; bf16 stays default). "
+        "int8 with --paged-attn kernel wants --kv-block-size a multiple "
+        "of 32 (1-byte Mosaic sublane)",
     )
     s.add_argument(
         "--paged-attn", choices=("auto", "kernel", "xla"), default="auto",

@@ -184,11 +184,14 @@ def forward_layers_paged(
     write_valid=True,
     tp_axis: Optional[str] = None,
     backend: str = "auto",
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    k_scale: Optional[jnp.ndarray] = None,  # [L, NB, Nkv] (quantized)
+    v_scale: Optional[jnp.ndarray] = None,
+):
     """Paged serve-decode counterpart of ``forward_layers`` (see
     ``models/llama.forward_layers_paged`` — same contract: fresh KV lands
-    via ``write_block_kv``, attention streams the table's blocks, kpos
-    bookkeeping stays with the caller)."""
+    via ``write_block_kv`` (quantizing at insert when the arena carries
+    scales), attention streams the table's blocks (dequant fused), kpos
+    bookkeeping stays with the caller; returns scale arenas too)."""
     from ..ops.paged_attention import paged_attention, write_block_kv
     from .stack import scan_layers_paged
 
@@ -196,23 +199,34 @@ def forward_layers_paged(
         write_valid
     )
 
-    def apply(p, valid, h, k_l, v_l):
+    def apply(p, valid, h, k_l, v_l, ks_l, vs_l):
         out = {}
 
         def attn_fn(q, k, v):
-            k_a, v_a = write_block_kv(
-                k_l, v_l, block_table, cols, k, v, valid=wv & valid,
-            )
-            out["k"], out["v"] = k_a, v_a
+            if ks_l is None:
+                k_a, v_a = write_block_kv(
+                    k_l, v_l, block_table, cols, k, v, valid=wv & valid,
+                )
+                out["kv"] = (k_a, v_a, None, None)
+            else:
+                out["kv"] = write_block_kv(
+                    k_l, v_l, block_table, cols, k, v, valid=wv & valid,
+                    k_scale=ks_l, v_scale=vs_l,
+                )
+                k_a, v_a = out["kv"][0], out["kv"][1]
             return paged_attention(
                 q, k_a, v_a, block_table, positions, kv_positions,
-                backend=backend,
+                backend=backend, k_scale=out["kv"][2],
+                v_scale=out["kv"][3],
             )
 
         h = attn_mlp_block(cfg, p, h, attn_fn, tp_axis)
-        return h, out["k"], out["v"]
+        return (h, *out["kv"])
 
-    return scan_layers_paged(layers, h, k_arena, v_arena, apply, layer_mask)
+    return scan_layers_paged(
+        layers, h, k_arena, v_arena, apply, layer_mask,
+        k_scale=k_scale, v_scale=v_scale,
+    )
 
 
 def final_logits(cfg: ModelConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:

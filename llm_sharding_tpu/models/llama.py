@@ -221,28 +221,39 @@ def paged_decoder_layer(
     write_valid,  # scalar bool — ring-inactive microsteps gate writes
     tp_axis: Optional[str] = None,
     backend: str = "auto",
+    k_scale: Optional[jnp.ndarray] = None,  # [NB, Nkv] — quantized arena
+    v_scale: Optional[jnp.ndarray] = None,
 ):
     """Decode-path layer over the pooled arena: the step's fresh KV lands
     via a block-indexed scatter and attention streams exactly the blocks
     the table names (``ops/paged_attention``) — the logical window is
-    never materialized."""
+    never materialized. A quantized arena (``k_scale``/``v_scale``)
+    quantizes the fresh entries at insert and dequantizes inside the
+    attention op (fused into the kernel's per-block DMA loop)."""
     from ..ops.paged_attention import paged_attention, write_block_kv
 
     out = {}
 
     def attn_fn(q, k, v):
-        k_a, v_a = write_block_kv(
-            k_arena, v_arena, block_table, cols, k, v,
-            valid=write_valid & valid,
-        )
-        out["k"], out["v"] = k_a, v_a
+        if k_scale is None:
+            k_a, v_a = write_block_kv(
+                k_arena, v_arena, block_table, cols, k, v,
+                valid=write_valid & valid,
+            )
+            out["kv"] = (k_a, v_a, None, None)
+        else:
+            k_a, v_a, ks, vs = write_block_kv(
+                k_arena, v_arena, block_table, cols, k, v,
+                valid=write_valid & valid, k_scale=k_scale, v_scale=v_scale,
+            )
+            out["kv"] = (k_a, v_a, ks, vs)
         return paged_attention(
             q, k_a, v_a, block_table, positions, kv_positions,
-            backend=backend,
+            backend=backend, k_scale=out["kv"][2], v_scale=out["kv"][3],
         )
 
     h = attn_mlp_block(cfg, p, h, cos, sin, attn_fn, tp_axis)
-    return h, out["k"], out["v"]
+    return (h, *out["kv"])
 
 
 def forward_layers_paged(
@@ -259,11 +270,14 @@ def forward_layers_paged(
     write_valid=True,
     tp_axis: Optional[str] = None,
     backend: str = "auto",
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    k_scale: Optional[jnp.ndarray] = None,  # [L, NB, Nkv] (quantized)
+    v_scale: Optional[jnp.ndarray] = None,
+):
     """Paged counterpart of ``forward_layers`` for the serve decode path:
     scans the layer stack over the pooled arena (``stack.scan_layers_paged``)
     instead of a materialized per-row window. Returns ``(h, k_arena,
-    v_arena)`` — kpos bookkeeping stays with the caller."""
+    v_arena, k_scale, v_scale)`` — scale outputs are None unquantized;
+    kpos bookkeeping stays with the caller."""
     from .stack import scan_layers_paged
 
     cos, sin = rope_cos_sin(positions, cfg, dtype=jnp.float32)
@@ -271,13 +285,17 @@ def forward_layers_paged(
         write_valid
     )
 
-    def apply(p, valid, h, k_l, v_l):
+    def apply(p, valid, h, k_l, v_l, ks_l, vs_l):
         return paged_decoder_layer(
             cfg, p, valid, h, k_l, v_l, block_table, cols, cos, sin,
             positions, kv_positions, wv, tp_axis, backend,
+            k_scale=ks_l, v_scale=vs_l,
         )
 
-    return scan_layers_paged(layers, h, k_arena, v_arena, apply, layer_mask)
+    return scan_layers_paged(
+        layers, h, k_arena, v_arena, apply, layer_mask,
+        k_scale=k_scale, v_scale=v_scale,
+    )
 
 
 def forward_layers(

@@ -77,9 +77,12 @@ def scan_layers_paged(
     h: jnp.ndarray,
     k_arena: jnp.ndarray,  # [L, NB, BS, Nkv, D] pooled per-layer blocks
     v_arena: jnp.ndarray,
-    apply_layer,  # (p, valid, h, k_l, v_l) -> (h, k_l, v_l)
+    apply_layer,  # (p, valid, h, k_l, v_l, ks_l, vs_l) ->
+    #   (h, k_l, v_l, ks_l, vs_l) — scale slices are None unquantized
     layer_mask: Optional[jnp.ndarray] = None,
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    k_scale: Optional[jnp.ndarray] = None,  # [L, NB, Nkv] f32 per-block-
+    v_scale: Optional[jnp.ndarray] = None,  # per-head scales (quantized)
+):
     """Paged analogue of ``scan_layers``: the cache is the pooled block
     arena, and a layer's update is the tiny block-indexed scatter of this
     step's entries (``ops/paged_attention.write_block_kv`` inside
@@ -88,25 +91,44 @@ def scan_layers_paged(
     ``kpos`` window; there is no per-scan ``KVCache.pos`` here). Layer
     validity is passed INTO ``apply_layer`` so masked (padding) layers
     gate their scattered entries instead of ``where``-ing the whole arena;
-    the hidden-state gate stays here like the dense scan."""
+    the hidden-state gate stays here like the dense scan.
+
+    A QUANTIZED arena (int8/fp8 storage) carries its per-layer scale
+    arenas through the same scan (``None`` leaves are empty pytree nodes,
+    so the unquantized carry is unchanged). Returns ``(h, k_arena,
+    v_arena, k_scale, v_scale)`` — the scale outputs are None when the
+    arena is unquantized."""
     L = k_arena.shape[0]
     if layer_mask is None:
         layer_mask = jnp.ones((L,), bool)
 
-    def body(carry, xs):
-        h, k_all, v_all = carry
-        p, l, valid = xs
-        k_l = jax.lax.dynamic_index_in_dim(k_all, l, keepdims=False)
-        v_l = jax.lax.dynamic_index_in_dim(v_all, l, keepdims=False)
-        h_new, k_l, v_l = apply_layer(p, valid, h, k_l, v_l)
-        h = jnp.where(valid, h_new, h)
-        zeros = (0,) * (k_all.ndim - 1)
-        k_all = jax.lax.dynamic_update_slice(k_all, k_l[None], (l, *zeros))
-        v_all = jax.lax.dynamic_update_slice(v_all, v_l[None], (l, *zeros))
-        return (h, k_all, v_all), None
+    def take(all_, l):
+        return (
+            None if all_ is None
+            else jax.lax.dynamic_index_in_dim(all_, l, keepdims=False)
+        )
 
-    (h, k_arena, v_arena), _ = jax.lax.scan(
-        body, (h, k_arena, v_arena),
+    def put(all_, l, one):
+        if all_ is None:
+            return None
+        zeros = (0,) * (all_.ndim - 1)
+        return jax.lax.dynamic_update_slice(all_, one[None], (l, *zeros))
+
+    def body(carry, xs):
+        h, k_all, v_all, ks_all, vs_all = carry
+        p, l, valid = xs
+        h_new, k_l, v_l, ks_l, vs_l = apply_layer(
+            p, valid, h, take(k_all, l), take(v_all, l),
+            take(ks_all, l), take(vs_all, l),
+        )
+        h = jnp.where(valid, h_new, h)
+        return (
+            h, put(k_all, l, k_l), put(v_all, l, v_l),
+            put(ks_all, l, ks_l), put(vs_all, l, vs_l),
+        ), None
+
+    (h, k_arena, v_arena, k_scale, v_scale), _ = jax.lax.scan(
+        body, (h, k_arena, v_arena, k_scale, v_scale),
         (layers, jnp.arange(L, dtype=jnp.int32), layer_mask),
     )
-    return h, k_arena, v_arena
+    return h, k_arena, v_arena, k_scale, v_scale

@@ -431,6 +431,15 @@ KV_BLOCKS_IN_USE = REGISTRY.gauge(
     "server_kv_blocks_in_use",
     "KV arena blocks currently held by live requests or shared prefixes",
 )
+ARENA_BYTES = REGISTRY.gauge(
+    "server_arena_bytes",
+    "Device bytes of the pooled KV arena across live paged servers, by "
+    "storage dtype (K + V codes plus, for quantized int8/fp8 arenas, the "
+    "per-block-per-head f32 scale arenas — computed via "
+    "runtime/blocks.BlockAllocator.bytes_per_block, so HBM savings from "
+    "--kv-dtype are observable, not just asserted)",
+    labels=("dtype",),
+)
 KV_WASTE_FRAC = REGISTRY.gauge(
     "server_kv_waste_frac",
     "1 - live tokens / allocated token slots over the in-use blocks: the "

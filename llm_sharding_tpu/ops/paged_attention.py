@@ -61,6 +61,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .attention import cached_attention
+from .quant import kv_dequantize, kv_qmax, kv_quantize
 from .. import _compat
 
 NEG_INF = -1e30  # python float: jnp constants can't be captured by kernels
@@ -87,21 +88,30 @@ def forced_backend() -> str | None:
     return raw
 
 
+def kernel_sublane(cache_dtype) -> int:
+    """Mosaic sublane count of a KV storage dtype (8 at 4 bytes, 16 at 2,
+    32 at 1-byte int8/fp8) — THE one definition; ``kernel_eligible`` and
+    the serve-side error messages both read it so they cannot drift."""
+    return 32 // max(jnp.dtype(cache_dtype).itemsize, 1)
+
+
 def kernel_eligible(head_dim: int, block_size: int, cache_dtype) -> bool:
     """Mosaic-layout eligibility of the real (non-interpret) kernel:
     the (BS, D) block tiles as (sublane, 128) — D must be a lane multiple
-    and BS a sublane multiple for the CACHE dtype (8 at 4 bytes, 16 at 2,
-    32 at 1). Shared by the trace-time dispatch below and the host-side
+    and BS a sublane multiple for the CACHE dtype (``kernel_sublane``).
+    Shared by the trace-time dispatch below and the host-side
     serve validation (``runtime/server.py``), so ``--paged-attn kernel``
     fails loud at construction instead of as a Mosaic error mid-serve."""
-    sublane = 32 // max(jnp.dtype(cache_dtype).itemsize, 1)
-    return head_dim % 128 == 0 and block_size % sublane == 0
+    return head_dim % 128 == 0 and block_size % kernel_sublane(cache_dtype) == 0
 
 
 def gather_block_kv(
     k_arena: jnp.ndarray,  # [NB, BS, Nkv, D] pooled key blocks
     v_arena: jnp.ndarray,  # [NB, BS, Nkv, D]
     block_table: jnp.ndarray,  # [B, T] int32 arena block ids per row
+    k_scale: jnp.ndarray = None,  # [NB, Nkv] f32 per-block-per-head scales
+    v_scale: jnp.ndarray = None,  # (quantized arenas only)
+    out_dtype=None,  # dequant target; defaults to the scale dtype
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Assemble each row's logical KV window ``[B, T*BS, Nkv, D]`` from the
     arena. The gather is the XLA fallback's only extra cost over dense
@@ -111,15 +121,26 @@ def gather_block_kv(
     garbage writes, and although attention masks those positions to
     probability exactly 0, a non-finite garbage value would still produce
     ``0 × Inf = NaN`` in the PV product — zeroing closes the channel
-    without touching live numerics."""
+    without touching live numerics.
+
+    With ``k_scale``/``v_scale`` (a quantized int8/fp8 arena) the gather
+    DEQUANTIZES: each block's values multiply by its per-head scale and
+    the window comes out in ``out_dtype`` — the XLA-path analogue of the
+    Pallas kernel's in-VMEM fused dequant."""
     B, T = block_table.shape
     BS = k_arena.shape[1]
+    k = k_arena[block_table]  # [B, T, BS, Nkv, D]
+    v = v_arena[block_table]
+    if k_scale is not None:
+        dt = out_dtype or k_scale.dtype
+        k = kv_dequantize(k, k_scale[block_table][:, :, None, :, None], dt)
+        v = kv_dequantize(v, v_scale[block_table][:, :, None, :, None], dt)
     live = (block_table != 0)[:, :, None, None, None]
-    k = jnp.where(live, k_arena[block_table], jnp.zeros((), k_arena.dtype))
-    v = jnp.where(live, v_arena[block_table], jnp.zeros((), v_arena.dtype))
+    k = jnp.where(live, k, jnp.zeros((), k.dtype))
+    v = jnp.where(live, v, jnp.zeros((), v.dtype))
     return (
-        k.reshape(B, T * BS, *k_arena.shape[2:]),
-        v.reshape(B, T * BS, *v_arena.shape[2:]),
+        k.reshape(B, T * BS, *k.shape[3:]),
+        v.reshape(B, T * BS, *v.shape[3:]),
     )
 
 
@@ -131,7 +152,9 @@ def write_block_kv(
     k_new: jnp.ndarray,  # [B, S, Nkv, D]
     v_new: jnp.ndarray,  # [B, S, Nkv, D]
     valid=None,  # scalar or [B, S] bool — False entries keep old contents
-) -> tuple[jnp.ndarray, jnp.ndarray]:
+    k_scale: jnp.ndarray = None,  # [NB, Nkv] f32 — quantized arenas only
+    v_scale: jnp.ndarray = None,
+):
     """Scatter a step's fresh KV entries into their OWNING arena blocks —
     the decode-path replacement for the full-window gather→update→scatter
     round trip: per step the arena update is ``B × S`` slots, not the
@@ -147,21 +170,70 @@ def write_block_kv(
     masked pipeline layers stay no-ops without a full-arena ``where``
     (which would copy the pool per layer per microstep). Collisions
     (several rows trash-mapped onto the same slot) resolve last-wins:
-    only the sink can collide, and it is a garbage sink by contract."""
+    only the sink can collide, and it is a garbage sink by contract.
+
+    With ``k_scale``/``v_scale`` (quantized int8/fp8 arena) the write
+    QUANTIZES AT INSERT against a RUNNING per-block-per-head absmax: a
+    fresh entry that raises its block's scale first requantizes the
+    block's existing codes to the new scale (a dequant→requant round on
+    exactly the touched blocks — ≤ one block per written entry), then
+    lands quantized. Scale updates scatter with ``.at[].max`` so several
+    entries of one call hitting the same block resolve order-free, and
+    the block-content rewrite is identical for every colliding entry
+    (same source block, same final scale) — race-free like the prefix
+    broadcast. Returns ``(k_arena, v_arena, k_scale, v_scale)`` in
+    quantized mode, the plain ``(k_arena, v_arena)`` pair otherwise."""
     BS = k_arena.shape[1]
     W = block_table.shape[1] * BS
     cols = jnp.clip(cols, 0, W - 1)  # defense: XLA clamps, tables don't
     blk = jnp.take_along_axis(block_table, cols // BS, axis=1)  # [B, S]
     slot = cols % BS
-    kn = k_new.astype(k_arena.dtype)
-    vn = v_new.astype(v_arena.dtype)
+    if k_scale is None:
+        kn = k_new.astype(k_arena.dtype)
+        vn = v_new.astype(v_arena.dtype)
+        if valid is not None:
+            keep = jnp.asarray(valid)
+            if keep.ndim:  # [B, S] → broadcast over the (Nkv, D) entry dims
+                keep = keep[..., None, None]
+            kn = jnp.where(keep, kn, k_arena[blk, slot])
+            vn = jnp.where(keep, vn, v_arena[blk, slot])
+        return k_arena.at[blk, slot].set(kn), v_arena.at[blk, slot].set(vn)
+
+    qmax = kv_qmax(k_arena.dtype)
+    keep = None
     if valid is not None:
         keep = jnp.asarray(valid)
-        if keep.ndim:  # [B, S] → broadcast over the (Nkv, D) entry dims
-            keep = keep[..., None, None]
-        kn = jnp.where(keep, kn, k_arena[blk, slot])
-        vn = jnp.where(keep, vn, v_arena[blk, slot])
-    return k_arena.at[blk, slot].set(kn), v_arena.at[blk, slot].set(vn)
+        if not keep.ndim:
+            keep = jnp.broadcast_to(keep, cols.shape)
+
+    def one(arena, scale, new):
+        B, S, Nkv, D = new.shape
+        # candidate scale of each fresh entry (per kv head); invalid
+        # entries must neither grow the scale nor write
+        cand = jnp.max(jnp.abs(new.astype(jnp.float32)), axis=-1) / qmax
+        if keep is not None:
+            cand = jnp.where(keep[..., None], cand, 0.0)
+        s_old = scale[blk]  # [B, S, Nkv] pre-update block scales
+        scale_new = scale.at[blk].max(cand)
+        s_fin = scale_new[blk]  # post-scatter final scales
+        # requantize the touched blocks' existing codes to the final scale
+        # (a no-op rewrite when the scale did not grow: round(q * 1.0))
+        old = arena[blk]  # [B, S, BS, Nkv, D]
+        old_f = kv_dequantize(old, s_old[:, :, None, :, None], jnp.float32)
+        req = kv_quantize(old_f, s_fin[:, :, None, :, None], arena.dtype)
+        arena = arena.at[blk].set(req)
+        qn = kv_quantize(new, s_fin[..., None], arena.dtype)
+        if keep is not None:
+            idx = jnp.broadcast_to(
+                slot[:, :, None, None, None], (B, S, 1, Nkv, D)
+            )
+            old_entry = jnp.take_along_axis(req, idx, axis=2)[:, :, 0]
+            qn = jnp.where(keep[..., None, None], qn, old_entry)
+        return arena.at[blk, slot].set(qn), scale_new
+
+    k_arena, k_scale = one(k_arena, k_scale, k_new)
+    v_arena, v_scale = one(v_arena, v_scale, v_new)
+    return k_arena, v_arena, k_scale, v_scale
 
 
 def paged_attention_xla(
@@ -172,9 +244,15 @@ def paged_attention_xla(
     q_positions: jnp.ndarray,  # [B, S]
     kv_positions: jnp.ndarray,  # [B, T*BS] logical-column key positions
     scale: float | None = None,
+    k_scale: jnp.ndarray = None,  # [NB, Nkv] — quantized arenas only
+    v_scale: jnp.ndarray = None,
 ) -> jnp.ndarray:
-    """Gather + position-masked attention: exact on every backend."""
-    k, v = gather_block_kv(k_arena, v_arena, block_table)
+    """Gather + position-masked attention: exact on every backend. A
+    quantized arena dequantizes at the gather into the QUERY dtype — the
+    same dequant target as the fused kernel, so the two paths match."""
+    k, v = gather_block_kv(
+        k_arena, v_arena, block_table, k_scale, v_scale, out_dtype=q.dtype
+    )
     return cached_attention(q, k, v, q_positions, kv_positions, scale)
 
 
@@ -183,16 +261,18 @@ def _paged_kernel(
     q_ref,  # [1, 1, GS, D]
     k_ref,  # [1, 1, BS, D] — the arena block the index map picked
     v_ref,  # [1, 1, BS, D]
-    qpos_ref,  # [1, GS, 1] sublane-major
-    kvpos_ref,  # [1, 1, BS] lane-major (logical columns of block t)
-    out_ref,  # [1, 1, GS, D]
-    acc_ref,  # scratch [GS, D] f32
-    m_ref,  # scratch [GS, 128] f32
-    l_ref,  # scratch [GS, 128] f32
-    *,
+    *rest,  # quantized: ks_ref, vs_ref (1,1) SMEM per-block-per-head
+    #   scales, then the common refs; bf16: the common refs directly —
+    #   qpos [1, GS, 1], kvpos [1, 1, BS], out [1, 1, GS, D],
+    #   scratch acc [GS, D] f32, m [GS, 128] f32, l [GS, 128] f32
     scale,
     t_blocks,
+    quantized=False,
 ):
+    if quantized:
+        ks_ref, vs_ref = rest[0], rest[1]
+        rest = rest[2:]
+    qpos_ref, kvpos_ref, out_ref, acc_ref, m_ref, l_ref = rest
     t = pl.program_id(2)
 
     @pl.when(t == 0)
@@ -202,13 +282,22 @@ def _paged_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
 
     q = q_ref[0, 0]  # [GS, D]
+    k_blk, v_blk = k_ref[0, 0], v_ref[0, 0]  # [BS, D]
+    if quantized:
+        # THE fused dequant: the block streamed into VMEM as 1-byte codes
+        # (half/quarter the DMA bytes of bf16) and dequantizes here against
+        # its per-(block, head) scale — the bf16 window never exists in
+        # HBM. Dequant target is the query dtype, matching the XLA gather
+        # path bit for bit.
+        k_blk = (k_blk.astype(jnp.float32) * ks_ref[0, 0]).astype(q.dtype)
+        v_blk = (v_blk.astype(jnp.float32) * vs_ref[0, 0]).astype(q.dtype)
     # trash blocks (table entry 0) stream as zeros: their garbage contents
     # are position-masked to probability 0 below, but non-finite garbage
     # would still NaN the masked positions (0 x Inf) through the score and
     # PV products. where(), not multiply — Inf * 0 is itself NaN.
     live = tbl_ref[pl.program_id(0), pl.program_id(2)] != 0
-    k = jnp.where(live, k_ref[0, 0], jnp.zeros_like(k_ref[0, 0]))  # [BS, D]
-    v = jnp.where(live, v_ref[0, 0], jnp.zeros_like(v_ref[0, 0]))
+    k = jnp.where(live, k_blk, jnp.zeros_like(k_blk))  # [BS, D]
+    v = jnp.where(live, v_blk, jnp.zeros_like(v_blk))
 
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -256,6 +345,8 @@ def paged_attention_tpu(
     kv_positions: jnp.ndarray,  # [B, T*BS]
     scale: float | None = None,
     interpret: bool = False,
+    k_scale: jnp.ndarray = None,  # [NB, Nkv] — quantized arenas only
+    v_scale: jnp.ndarray = None,
 ) -> jnp.ndarray:
     """Pallas paged attention: grid ``(B, Nkv, T)``, the T axis sequential.
     Each step DMAs ONE arena block, chosen by the scalar-prefetched block
@@ -268,12 +359,20 @@ def paged_attention_tpu(
     tile + (GS, D)+2·(GS, 128) scratch — tiny at serving block sizes (e.g.
     BS=64, D=128: ~100 KB). Real-TPU use wants D a lane multiple (128) and
     BS a sublane multiple for the cache dtype; ``paged_attention`` gates on
-    that and interpret-mode covers the rest."""
+    that and interpret-mode covers the rest.
+
+    Quantized arenas (``k_scale``/``v_scale``): the per-block DMA moves
+    1-byte codes — HALF (int8 vs bf16) the per-step attention HBM traffic
+    — plus each block's (1, 1) per-head scale riding in SMEM, and the
+    dequant multiply runs in VMEM right before the score dot (the hook PR
+    6 left open). Int8 tiles want BS a multiple of 32 (1-byte sublane —
+    ``kernel_eligible``)."""
     B, S, Nh, D = q.shape
     NB, BS, Nkv = k_arena.shape[0], k_arena.shape[1], k_arena.shape[2]
     T = block_table.shape[1]
     G = Nh // Nkv
     GS = G * S
+    quantized = k_scale is not None
     if scale is None:
         scale = D ** -0.5
     if kv_positions.shape != (B, T * BS):
@@ -289,22 +388,36 @@ def paged_attention_tpu(
     vh = jnp.transpose(v_arena, (0, 2, 1, 3))
     kp = kv_positions[:, None, :]  # [B, 1, T*BS]
 
+    # the arena-block specs: each grid cell streams the block the
+    # scalar-prefetched table names; quantized runs add the block's
+    # per-head scale as a (1, 1) SMEM scalar picked by the same indices
+    block_spec = pl.BlockSpec(
+        (1, 1, BS, D), lambda b, k, t, tbl: (tbl[b, t], k, 0, 0)
+    )
+    scale_spec = pl.BlockSpec(
+        (1, 1), lambda b, k, t, tbl: (tbl[b, t], k),
+        memory_space=pltpu.SMEM,
+    )
+    in_specs = [
+        pl.BlockSpec((1, 1, GS, D), lambda b, k, t, tbl: (b, k, 0, 0)),
+        block_spec,
+        block_spec,
+    ]
+    operands = [block_table, qh, kh, vh]
+    if quantized:
+        in_specs += [scale_spec, scale_spec]
+        operands += [
+            k_scale.astype(jnp.float32), v_scale.astype(jnp.float32),
+        ]
+    in_specs += [
+        pl.BlockSpec((1, GS, 1), lambda b, k, t, tbl: (b, 0, 0)),
+        pl.BlockSpec((1, 1, BS), lambda b, k, t, tbl: (b, 0, t)),
+    ]
+    operands += [qp, kp]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B, Nkv, T),
-        in_specs=[
-            pl.BlockSpec((1, 1, GS, D), lambda b, k, t, tbl: (b, k, 0, 0)),
-            # the paged step: the arena block this grid cell streams is the
-            # table entry, read at index-map time from the prefetched scalars
-            pl.BlockSpec(
-                (1, 1, BS, D), lambda b, k, t, tbl: (tbl[b, t], k, 0, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, BS, D), lambda b, k, t, tbl: (tbl[b, t], k, 0, 0)
-            ),
-            pl.BlockSpec((1, GS, 1), lambda b, k, t, tbl: (b, 0, 0)),
-            pl.BlockSpec((1, 1, BS), lambda b, k, t, tbl: (b, 0, t)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, GS, D), lambda b, k, t, tbl: (b, k, 0, 0)
         ),
@@ -315,14 +428,16 @@ def paged_attention_tpu(
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_paged_kernel, scale=scale, t_blocks=T),
+        functools.partial(
+            _paged_kernel, scale=scale, t_blocks=T, quantized=quantized
+        ),
         out_shape=jax.ShapeDtypeStruct((B, Nkv, GS, D), q.dtype),
         grid_spec=grid_spec,
         compiler_params=_compat.pallas_tpu_compiler_params()(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(block_table, qh, kh, vh, qp, kp)
+    )(*operands)
     out = out.reshape(B, Nkv, G, S, D)
     return jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(B, S, Nh, D)
 
@@ -336,13 +451,18 @@ def paged_attention(
     kv_positions: jnp.ndarray,
     scale: float | None = None,
     backend: str = "auto",
+    k_scale: jnp.ndarray = None,  # [NB, Nkv] — quantized arenas only
+    v_scale: jnp.ndarray = None,
 ) -> jnp.ndarray:
     """Backend dispatch: the Pallas kernel on TPU for MXU-aligned shapes,
     the exact XLA gather path otherwise (CPU meshes, ragged head dims,
     sub-sublane block sizes — see ``kernel_eligible``). ``backend`` pins a
     path (``kernel`` / ``xla`` / ``interpret``); ``PAGED_FORCE_KERNEL``
     overrides ``auto`` only, so an explicit caller choice always wins.
-    Identical numerics either way (interpret-mode tested on CPU)."""
+    Identical numerics either way (interpret-mode tested on CPU). With
+    ``k_scale``/``v_scale`` the arena is quantized (int8/fp8): the kernel
+    fuses the dequant into its per-block DMA loop, the XLA path
+    dequantizes at the gather — both into the query dtype."""
     if backend not in BACKENDS:
         raise ValueError(
             f"paged_attention backend {backend!r}: expected one of "
@@ -355,7 +475,7 @@ def paged_attention(
     if backend == "interpret":
         return paged_attention_tpu(
             q, k_arena, v_arena, block_table, q_positions, kv_positions,
-            scale, interpret=True,
+            scale, interpret=True, k_scale=k_scale, v_scale=v_scale,
         )
     if backend == "kernel":
         # curated here too, not only in the serve-side resolution: a
@@ -385,8 +505,9 @@ def paged_attention(
     if use_pallas:
         return paged_attention_tpu(
             q, k_arena, v_arena, block_table, q_positions, kv_positions,
-            scale,
+            scale, k_scale=k_scale, v_scale=v_scale,
         )
     return paged_attention_xla(
-        q, k_arena, v_arena, block_table, q_positions, kv_positions, scale
+        q, k_arena, v_arena, block_table, q_positions, kv_positions, scale,
+        k_scale=k_scale, v_scale=v_scale,
     )

@@ -245,3 +245,87 @@ def quantize_params(
 
 def is_quantized(layers: dict) -> bool:
     return any(isinstance(v, QTensor) for v in layers.values())
+
+
+# --------------------------------------------------------------- KV cache
+# Quantized KV storage for the paged serve arena (KIVI, Liu et al. 2024;
+# KVQuant, Hooper et al. 2024 — KV bytes dominate serving HBM once weights
+# are int8). Scheme: symmetric per-block-per-kv-head absmax — one f32 scale
+# per (arena block, kv head), stored in a parallel scale arena shaped like
+# the block axis of the pool ([NB, Nkv] per layer). Per-head because head
+# magnitudes differ by orders of magnitude (per-channel would double scale
+# storage for little gain at serving block sizes); per-block because the
+# block is the arena's transfer unit — the Pallas decode kernel DMAs a
+# block and its one scale row together and dequantizes in VMEM
+# (``ops/paged_attention``), so quantized KV never materializes as bf16 in
+# HBM. Unlike weights, KV arrives incrementally: ``write_block_kv`` keeps
+# a RUNNING absmax per block — when a new entry raises a block's scale,
+# the block's existing codes are requantized to the new scale (a
+# dequant→requant round on exactly the touched blocks). bf16 KV stays the
+# serving default; quantized is opt-in and drift-gated (see bench's
+# kv-quant token-match fraction).
+
+#: ``--kv-dtype`` vocabulary. "bf16" means "store in the engine's compute
+#: cache dtype" (no quantization — the pre-existing exact path).
+KV_DTYPES = ("bf16", "int8", "fp8")
+
+#: Largest-magnitude code point the quantizer maps absmax onto.
+_KV_QMAX = {"int8": 127.0, "fp8": 448.0}  # e4m3fn max normal
+
+
+def kv_storage_dtype(name: str, compute_dtype=jnp.bfloat16):
+    """Resolve a ``--kv-dtype`` name to the arena storage dtype."""
+    if name == "bf16":
+        return jnp.dtype(compute_dtype)
+    if name == "int8":
+        return jnp.dtype(jnp.int8)
+    if name == "fp8":
+        return jnp.dtype(jnp.float8_e4m3fn)
+    raise ValueError(f"kv dtype must be one of {KV_DTYPES}, got {name!r}")
+
+
+def is_kv_quantized(dtype) -> bool:
+    """True for 1-byte KV storage dtypes (int8 / fp8) — the arenas that
+    carry a parallel scale arena and dequantize at read."""
+    dt = jnp.dtype(dtype)
+    return dt == jnp.dtype(jnp.int8) or dt == jnp.dtype(jnp.float8_e4m3fn)
+
+
+def kv_qmax(dtype) -> float:
+    dt = jnp.dtype(dtype)
+    if dt == jnp.dtype(jnp.int8):
+        return _KV_QMAX["int8"]
+    if dt == jnp.dtype(jnp.float8_e4m3fn):
+        return _KV_QMAX["fp8"]
+    raise ValueError(f"{dt.name} is not a quantized KV dtype")
+
+
+def fp8_kv_supported() -> bool:
+    """Whether this jax backend can round-trip float8_e4m3fn arrays (the
+    ``--kv-dtype fp8`` platform gate — checked once at server
+    construction, so unsupported platforms fail with a curated message
+    instead of a lowering error mid-serve)."""
+    try:
+        x = jnp.asarray([1.0, -2.0], jnp.float8_e4m3fn)
+        jax.block_until_ready(x.astype(jnp.float32) * 2.0)
+        return True
+    except Exception:  # noqa: BLE001 — any backend failure means "no"
+        return False
+
+
+def kv_quantize(x: jnp.ndarray, scale: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Quantize KV values against a (broadcastable) per-block-per-head
+    scale. ``scale`` is the running absmax / qmax, so values never exceed
+    the code range; a zero scale (virgin block) quantizes zeros to zeros
+    via the safe denominator."""
+    y = x.astype(jnp.float32) / jnp.maximum(scale, 1e-12)
+    qmax = kv_qmax(dtype)
+    if jnp.dtype(dtype) == jnp.dtype(jnp.int8):
+        return jnp.clip(jnp.round(y), -qmax, qmax).astype(jnp.int8)
+    return jnp.clip(y, -qmax, qmax).astype(dtype)
+
+
+def kv_dequantize(q: jnp.ndarray, scale: jnp.ndarray, out_dtype) -> jnp.ndarray:
+    """Inverse of ``kv_quantize`` (f32 multiply, cast to the compute
+    dtype — the same op the fused kernel applies per streamed block)."""
+    return (q.astype(jnp.float32) * scale).astype(out_dtype)

@@ -71,7 +71,9 @@ class ModelFns(NamedTuple):
     stage: Any  # (cfg, layers, h, cache, positions, mask) -> (h, cache)
     # paged serve-decode stage over the pooled arena (no materialized
     # window): (cfg, layers, h, k_arena, v_arena, tbl, cols, kv_pos,
-    # positions, mask, write_valid, backend) -> (h, k_arena, v_arena)
+    # positions, mask, write_valid, backend, k_scale, v_scale) ->
+    # (h, k_arena, v_arena, k_scale, v_scale) — the scale arenas ride a
+    # quantized (int8/fp8) arena and come back None otherwise
     stage_paged: Any = None
 
 
@@ -87,11 +89,12 @@ def model_fns(cfg: ModelConfig, tp_axis: Optional[str] = None) -> ModelFns:
         return fwd(cfg_, layers, h, cache, positions, mask, tp_axis=tp_axis)
 
     def stage_paged(cfg_, layers, h, k_arena, v_arena, tbl, cols, kv_pos,
-                    positions, mask, write_valid=True, backend="auto"):
+                    positions, mask, write_valid=True, backend="auto",
+                    k_scale=None, v_scale=None):
         return fwd_paged(
             cfg_, layers, h, k_arena, v_arena, tbl, cols, kv_pos,
             positions, mask, write_valid=write_valid, tp_axis=tp_axis,
-            backend=backend,
+            backend=backend, k_scale=k_scale, v_scale=v_scale,
         )
 
     return ModelFns(stage=stage, stage_paged=stage_paged)
@@ -172,27 +175,32 @@ def ring_chain(fns, cfg, layers, lmask, sidx, ring, num_stages, h, cache, positi
 
 def ring_chain_paged(fns, cfg, layers, lmask, sidx, ring, num_stages, h,
                      k_arena, v_arena, tbl, cols, kv_positions, positions,
-                     backend="auto"):
+                     backend="auto", k_scale=None, v_scale=None):
     """``ring_chain`` over the pooled paged arena (the serve programs'
     kernel decode path): the per-microstep activity gate moves from a
     whole-cache ``_tree_where`` (which would copy the ARENA — the whole
     pool, not one slot's window — every microstep) down to
     ``write_block_kv``'s per-entry ``valid``, so an inactive microstep's
     arena update writes back the values it just read. The hidden-state
-    gate is unchanged."""
+    gate is unchanged. Quantized arenas carry their scale arenas through
+    the loop (None carries are empty pytree nodes — the bf16 path is
+    unchanged); returns ``(h, k_arena, v_arena, k_scale, v_scale)``."""
 
     def micro(m, carry):
-        h, ka, va = carry
+        h, ka, va, ks, vs = carry
         active = m == sidx
-        h_new, ka, va = fns.stage_paged(
+        h_new, ka, va, ks, vs = fns.stage_paged(
             cfg, layers, h, ka, va, tbl, cols, kv_positions, positions,
             lmask, write_valid=active, backend=backend,
+            k_scale=ks, v_scale=vs,
         )
         h = jnp.where(active, h_new, h)
         h = jax.lax.ppermute(h, PIPE_AXIS, ring)
-        return h, ka, va
+        return h, ka, va, ks, vs
 
-    return jax.lax.fori_loop(0, num_stages, micro, (h, k_arena, v_arena))
+    return jax.lax.fori_loop(
+        0, num_stages, micro, (h, k_arena, v_arena, k_scale, v_scale)
+    )
 
 
 def validate_request(

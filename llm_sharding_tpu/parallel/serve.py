@@ -43,6 +43,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.cache import KVCache, POS_SENTINEL
 from ..models.config import ModelConfig
 from ..obs.metrics import REGISTRY
+from ..ops.quant import is_kv_quantized, kv_dequantize, kv_qmax, kv_quantize
 from ..ops.sampling import is_stop as _is_stop
 from .head import (
     _local_logits, head_specs, key_chain_split, local_view, psum_from,
@@ -76,8 +77,15 @@ class ServeState(NamedTuple):
 
     k: jax.Array          # [dev] dense: [S, Lp, M, C, Nkv, Dh];
     #   paged: the pooled arena [S, Lp, NB, BS, Nkv, Dh] — rows own block
-    #   subsets via ``block_tables`` (block 0 = the reserved trash sink)
+    #   subsets via ``block_tables`` (block 0 = the reserved trash sink).
+    #   Quantized KV serving stores the arena as int8/fp8 CODES
     v: jax.Array          # [dev] same layout as k
+    k_scale: jax.Array    # [dev] [S, Lp, NB, Nkv] f32 per-block-per-head
+    #   scales of a QUANTIZED arena (running absmax / qmax — see
+    #   ops/quant's KV section); dense and bf16-paged modes carry a
+    #   [S, 1, 1, 1] placeholder for pytree/snapshot parity, exactly like
+    #   ``block_tables`` in dense mode
+    v_scale: jax.Array    # [dev] same layout as k_scale
     kpos: jax.Array       # [dev] [S, M, W] key positions / sentinel, indexed
     #   by LOGICAL column (dense: W == C == the cache column; paged: column
     #   c lives in arena block table[row, c // BS] at slot c % BS) — always
@@ -126,11 +134,14 @@ def state_specs(state: ServeState, tp: int = 1) -> ServeState:
     dev = P(PIPE_AXIS)
     rep = P()
     kv = _kv_spec(tp)
+    # scale arenas are pipe-sharded only (full Nkv per shard; quantized KV
+    # is gated to tp == 1 by the server — heads-sharded scale plumbing is
+    # future work)
     return ServeState(
-        k=kv, v=kv, kpos=dev, h=dev, h_valid=dev, pos_slots=dev,
-        write_off=dev, out=rep, lengths=rep, done=rep, budget=rep,
-        inject=rep, inject_pending=rep, rng=rep, temp=rep, topk=rep,
-        topp=rep, block_tables=rep, m=rep,
+        k=kv, v=kv, k_scale=dev, v_scale=dev, kpos=dev, h=dev,
+        h_valid=dev, pos_slots=dev, write_off=dev, out=rep, lengths=rep,
+        done=rep, budget=rep, inject=rep, inject_pending=rep, rng=rep,
+        temp=rep, topk=rep, topp=rep, block_tables=rep, m=rep,
     )
 
 
@@ -153,11 +164,15 @@ def state_specs(state: ServeState, tp: int = 1) -> ServeState:
 # garbage sink) — so last-wins scatter order is immaterial.
 
 
-def _gather_window(k_arena, v_arena, tbl, block_size):
+def _gather_window(k_arena, v_arena, tbl, block_size,
+                   k_scale=None, v_scale=None, out_dtype=None):
     """Assemble a slot's logical K and V windows from the pooled arena:
     ``[Lp, NB, BS, ...] , tbl [Bs, T] -> 2 × [Lp, Bs, T*BS, ...]`` — THE
     shared helper for every surviving full-window consumer (prefill-chunk
-    continuation, admit's doc reference, host snapshot tooling).
+    continuation, admit's doc reference, host snapshot tooling). With
+    ``k_scale``/``v_scale`` (a QUANTIZED int8/fp8 arena) the gather also
+    dequantizes into ``out_dtype`` — the prefill paths compute over a
+    full-precision window and requantize only at the scatter.
 
     Trash-zeroing contract (stated once, here): trash-mapped entries
     (block 0) gather as ZEROS, not the trash block's contents. Parked rows
@@ -172,15 +187,18 @@ def _gather_window(k_arena, v_arena, tbl, block_size):
     contract on the decode paths (``gather_block_kv`` zeroes at the
     gather; the Pallas kernel gates trash blocks at the stream)."""
     return (
-        _gather_pages(k_arena, tbl, block_size),
-        _gather_pages(v_arena, tbl, block_size),
+        _gather_pages(k_arena, tbl, block_size, k_scale, out_dtype),
+        _gather_pages(v_arena, tbl, block_size, v_scale, out_dtype),
     )
 
 
-def _gather_pages(arena, tbl, block_size):
+def _gather_pages(arena, tbl, block_size, scale=None, out_dtype=None):
     """One-array gather behind ``_gather_window`` (see its contract)."""
     g = arena[:, tbl]  # [Lp, Bs, T, BS, ...]
     Lp, Bs, T = g.shape[0], g.shape[1], g.shape[2]
+    if scale is not None:
+        sc = scale[:, tbl]  # [Lp, Bs, T, Nkv]
+        g = kv_dequantize(g, sc[:, :, :, None, :, None], out_dtype)
     live = (tbl != 0).reshape(1, Bs, T, 1, *([1] * (g.ndim - 4)))
     g = jnp.where(live, g, jnp.zeros((), g.dtype))
     return g.reshape(Lp, Bs, T * block_size, *g.shape[4:])
@@ -192,6 +210,27 @@ def _scatter_pages(arena, tbl, window, block_size):
     vals = window.reshape(Lp, Bs, W // block_size, block_size,
                           *window.shape[3:])
     return arena.at[:, tbl].set(vals)
+
+
+def _scatter_pages_q(arena, scale, tbl, window, block_size):
+    """Quantizing inverse gather for an int8/fp8 arena: per-block-per-head
+    absmax scales computed over the FULLY materialized window (the prefill
+    paths always scatter whole blocks, so no running-max bookkeeping —
+    each mapped block's scale is simply reset to its content's absmax).
+    Collisions are the same population as ``_scatter_pages``'s and stay
+    race-free for the same reasons: shared prefix blocks receive identical
+    broadcast values (hence identical codes AND scales) from every
+    admission, and the trash block is a garbage sink whose codes/scales
+    are never dequantized (readers zero-gate table entry 0)."""
+    Lp, Bs, W = window.shape[0], window.shape[1], window.shape[2]
+    T = W // block_size
+    vals = window.reshape(Lp, Bs, T, block_size, *window.shape[3:])
+    qmax = kv_qmax(arena.dtype)
+    sc = (
+        jnp.max(jnp.abs(vals.astype(jnp.float32)), axis=(3, 5)) / qmax
+    )  # [Lp, Bs, T, Nkv]
+    q = kv_quantize(vals, sc[:, :, :, None, :, None], arena.dtype)
+    return arena.at[:, tbl].set(q), scale.at[:, tbl].set(sc)
 
 
 def _slot_tables(st, row0, Bs):
@@ -271,9 +310,19 @@ def make_state(
         kv_shape = (S, *block_pool_shape(cfg, kv_blocks, kv_block_size, Lp))
     else:
         kv_shape = (S, Lp, M, C, cfg.num_key_value_heads, cfg.head_dim_)
+    # quantized (int8/fp8) arenas carry per-block-per-head scale arenas;
+    # everything else gets the minimal placeholder (pytree parity — same
+    # treatment as dense mode's [M, 1] block-table stub)
+    quantized = paged and is_kv_quantized(cache_dtype)
+    scale_shape = (
+        (S, Lp, kv_blocks, cfg.num_key_value_heads) if quantized
+        else (S, 1, 1, 1)
+    )
     state = ServeState(
         k=zeros(kv_shape, cache_dtype, dev_kv),
         v=zeros(kv_shape, cache_dtype, dev_kv),
+        k_scale=zeros(scale_shape, jnp.float32, dev),
+        v_scale=zeros(scale_shape, jnp.float32, dev),
         kpos=put(np.full((S, M, C), int(POS_SENTINEL), np.int32), dev),
         h=put(np.zeros((S, Bs, 1, H), act_dtype), dev),
         h_valid=put(np.zeros((S,), np.bool_), dev),
@@ -359,7 +408,7 @@ def prefix_prefill(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "block_size", "tp")
+    jax.jit, static_argnames=("mesh", "block_size", "tp", "out_dtype")
 )
 def gather_prefix_kv(
     mesh: Mesh,
@@ -368,6 +417,9 @@ def gather_prefix_kv(
     blocks: jnp.ndarray,   # [T] int32 arena block ids covering the prefix
     block_size: int,
     tp: int = 1,
+    k_scale: jnp.ndarray = None,  # ServeState.k_scale — quantized arenas:
+    v_scale: jnp.ndarray = None,  # the handle dequantizes to out_dtype
+    out_dtype=None,
 ):
     """Assemble a ``serve_admit``-compatible prefix handle STRAIGHT FROM
     THE ARENA — the device half of the automatic radix prefix cache
@@ -384,10 +436,18 @@ def gather_prefix_kv(
     program serve both the explicit-handle and the radix path."""
     kv_spec = _kv_spec(tp)
 
-    def body(k, v, tbl):
+    def body(k, v, tbl, ks, vs):
         k, v = k[0], v[0]  # local [Lp, NB, BS, nkv, Dh]
         gk = k[:, tbl]     # [Lp, T, BS, nkv, Dh]
         gv = v[:, tbl]
+        if ks is not None:
+            # quantized arena: the handle carries DEQUANTIZED values (the
+            # admission that consumes it requantizes at its own scatter) —
+            # prefix compute quality is full precision either way
+            sk = ks[0][:, tbl]  # [Lp, T, nkv]
+            sv = vs[0][:, tbl]
+            gk = kv_dequantize(gk, sk[:, :, None, :, None], out_dtype)
+            gv = kv_dequantize(gv, sv[:, :, None, :, None], out_dtype)
         Lp, T = gk.shape[0], gk.shape[1]
         gk = gk.reshape(Lp, 1, T * block_size, *gk.shape[3:])
         gv = gv.reshape(Lp, 1, T * block_size, *gv.shape[3:])
@@ -397,10 +457,13 @@ def gather_prefix_kv(
     return shard_map(
         body,
         mesh=mesh,
-        in_specs=(kv_spec, kv_spec, P()),
+        in_specs=(
+            kv_spec, kv_spec, P(),
+            P(PIPE_AXIS), P(PIPE_AXIS),  # leafless no-ops when None
+        ),
         out_specs=(kv_spec, kv_spec, P(PIPE_AXIS)),
         check_vma=False,
-    )(k_arena, v_arena, blocks)
+    )(k_arena, v_arena, blocks, k_scale, v_scale)
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -413,6 +476,23 @@ def write_arena_blocks(k_arena, v_arena, blocks, k_host, v_host):
     return (
         k_arena.at[:, :, blocks].set(k_host),
         v_arena.at[:, :, blocks].set(v_host),
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+def write_arena_blocks_q(
+    k_arena, v_arena, k_scale, v_scale, blocks,
+    k_host, v_host, ks_host, vs_host,
+):
+    """``write_arena_blocks`` for a QUANTIZED arena: the demoted codes AND
+    their per-block-per-head scales restore verbatim (the host tier
+    round-trips quantized bytes — twice the cached tokens per host-RAM
+    byte, same bit-exactness contract)."""
+    return (
+        k_arena.at[:, :, blocks].set(k_host),
+        v_arena.at[:, :, blocks].set(v_host),
+        k_scale.at[:, :, blocks].set(ks_host),
+        v_scale.at[:, :, blocks].set(vs_host),
     )
 
 
@@ -527,6 +607,7 @@ def serve_admit(
     nkv = cfg.num_key_value_heads // tp  # heads LOCAL to a tensor shard
     ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
     C = state.out.shape[1]
+    quantized = is_kv_quantized(state.k.dtype)  # trace-time constant
 
     def body(stage_layers, layer_mask, head_params, state, prompts,
              prompt_len, row_valid, slot, max_new, seeds, temperature,
@@ -612,7 +693,20 @@ def serve_admit(
         # a prefix handle) drives every length-indexed bookkeeping field
         total = pfx + prompt_len
         off0 = 0 if prefix_kv is None else int(prefix_kv[0].shape[3])
-        if block_size:
+        scale_upd = {}
+        if block_size and quantized:
+            # insert-quantization: the slot's full-precision window (the
+            # prefill just computed it) scatters as codes + fresh
+            # per-block scales — quantized KV never exists as bf16 in HBM
+            tbl = _slot_tables(st, row0, Bs)
+            k_new, ks_new = _scatter_pages_q(
+                st.k, st.k_scale, tbl, cache.k, block_size
+            )
+            v_new, vs_new = _scatter_pages_q(
+                st.v, st.v_scale, tbl, cache.v, block_size
+            )
+            scale_upd = {"k_scale": ks_new, "v_scale": vs_new}
+        elif block_size:
             tbl = _slot_tables(st, row0, Bs)
             k_new = _scatter_pages(st.k, tbl, cache.k, block_size)
             v_new = _scatter_pages(st.v, tbl, cache.v, block_size)
@@ -687,6 +781,7 @@ def serve_admit(
             write_off=write_off, out=out, lengths=lengths, budget=budget,
             done=done, inject=inject, inject_pending=inject_pending,
             h_valid=h_valid, rng=rng, temp=temp, topk=topk, topp=topp,
+            **scale_upd,
         )
         new = jax.tree.map(
             lambda spec, leaf: leaf[None] if _dev(spec) else leaf,
@@ -721,7 +816,9 @@ def serve_admit(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "mesh", "num_stages", "tp", "block_size"),
+    static_argnames=(
+        "cfg", "mesh", "num_stages", "tp", "block_size", "cache_dtype",
+    ),
     donate_argnums=(5,),  # see serve_admit
 )
 def serve_prefill_chunk(
@@ -741,6 +838,9 @@ def serve_prefill_chunk(
     num_stages: int,
     tp: int = 1,
     block_size: int = 0,  # static: paged-KV block size (0 = dense state)
+    cache_dtype=None,  # static: the COMPUTE dtype a quantized arena's
+    #   window dequantizes into between chunks (None → the activation
+    #   dtype); inert for dense/bf16 arenas, whose window IS the storage
 ):
     """One bounded chunk of an admission prefill (r2 weak #4 / next-#4).
 
@@ -756,6 +856,8 @@ def serve_prefill_chunk(
     fns = model_fns(cfg, tp_axis=TENSOR_AXIS if tp > 1 else None)
     Bs, Sc = tokens.shape
     ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+    quantized = is_kv_quantized(state.k.dtype)  # trace-time constant
+    win_dtype = cache_dtype or state.h.dtype  # quantized window target
 
     def body(stage_layers, layer_mask, head_params, state, tokens, positions,
              slot, chunk_off, reset):
@@ -768,7 +870,18 @@ def serve_prefill_chunk(
             state_specs(state, tp), state,
         )
         row0 = slot * Bs
-        if block_size:
+        if block_size and quantized:
+            # dequantize the already-prefilled chunks into the compute
+            # window; the scatter below requantizes the whole window with
+            # fresh per-block scales (earlier chunks pay one
+            # dequant→requant round per later chunk — the drift the
+            # kv-quant quality gate budgets for)
+            tbl = _slot_tables(st, row0, Bs)
+            k_rows, v_rows = _gather_window(
+                st.k, st.v, tbl, block_size, st.k_scale, st.v_scale,
+                win_dtype,
+            )
+        elif block_size:
             tbl = _slot_tables(st, row0, Bs)
             k_rows, v_rows = _gather_window(st.k, st.v, tbl, block_size)
         else:
@@ -789,7 +902,16 @@ def serve_prefill_chunk(
             positions,
         )
 
-        if block_size:
+        scale_upd = {}
+        if block_size and quantized:
+            k_new, ks_new = _scatter_pages_q(
+                st.k, st.k_scale, tbl, cache.k, block_size
+            )
+            v_new, vs_new = _scatter_pages_q(
+                st.v, st.v_scale, tbl, cache.v, block_size
+            )
+            scale_upd = {"k_scale": ks_new, "v_scale": vs_new}
+        elif block_size:
             k_new = _scatter_pages(st.k, tbl, cache.k, block_size)
             v_new = _scatter_pages(st.v, tbl, cache.v, block_size)
         else:
@@ -811,7 +933,8 @@ def serve_prefill_chunk(
         out = jax.lax.dynamic_update_slice(out, tokens, (row0, chunk_off))
 
         new = st._replace(
-            k=k_new, v=v_new, kpos=kpos_new, write_off=write_off, out=out
+            k=k_new, v=v_new, kpos=kpos_new, write_off=write_off, out=out,
+            **scale_upd,
         )
         return jax.tree.map(
             lambda spec, leaf: leaf[None] if _dev(spec) else leaf,
@@ -995,6 +1118,7 @@ def serve_chunk(
     last = num_stages - 1
     M = state.out.shape[0]
     Bs = M // num_stages
+    quantized = is_kv_quantized(state.k.dtype)  # trace-time constant
 
     def body(stage_layers, layer_mask, head_params, state):
         layers = jax.tree.map(lambda a: a[0], stage_layers)
@@ -1058,10 +1182,16 @@ def serve_chunk(
                 kv_pos = jax.lax.dynamic_update_slice(
                     kpos_rows, pos_rows[:, None], (0, off_r)
                 )
-                h_new, k_st, v_st = fns.stage_paged(
+                h_new, k_st, v_st, ks_st, vs_st = fns.stage_paged(
                     cfg, layers, h_in, s.k, s.v, tbl_r,
                     jnp.broadcast_to(off_r, (Bs, 1)), kv_pos,
                     pos_rows[:, None], lmask, backend=attn,
+                    k_scale=s.k_scale if quantized else None,
+                    v_scale=s.v_scale if quantized else None,
+                )
+                scale_upd = (
+                    {"k_scale": ks_st, "v_scale": vs_st} if quantized
+                    else {}
                 )
                 kpos_st = upd(s.kpos, kv_pos, 0)
             else:
@@ -1077,6 +1207,7 @@ def serve_chunk(
                 k_st = upd(s.k, cache_r_new.k, 1)
                 v_st = upd(s.v, cache_r_new.v, 1)
                 kpos_st = upd(s.kpos, cache_r_new.pos, 0)
+                scale_upd = {}
             write_off = jnp.where(
                 advance, s.write_off.at[r].add(1), s.write_off
             )
@@ -1166,7 +1297,7 @@ def serve_chunk(
                 k=k_st, v=v_st, kpos=kpos_st, h=h_out, h_valid=h_valid_out,
                 pos_slots=pos_slots, write_off=write_off, out=out,
                 lengths=lengths, done=done, inject_pending=inject_pending,
-                rng=rng, m=m + 1,
+                rng=rng, m=m + 1, **scale_upd,
             )
             return new_s, log_i
 
@@ -1273,6 +1404,7 @@ def serve_verify(
     ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
     C_total = state.out.shape[1]
     scratch = C_total - (K + 1)
+    quantized = is_kv_quantized(state.k.dtype)  # trace-time constant
 
     def body(stage_layers, layer_mask, head_params, state, draft, draft_len,
              slot, cache_delta):
@@ -1328,11 +1460,18 @@ def serve_verify(
                 st.kpos, row0, Bs, axis=0
             )
             kv_pos = kpos_rows.at[rowsel, colsel].set(positions)
-            h, k_full, v_full = ring_chain_paged(
+            h, k_full, v_full, ks_full, vs_full = ring_chain_paged(
                 fns, cfg, layers, lmask, sidx, ring, num_stages, h,
                 st.k, st.v, tbl, cols, kv_pos, positions, backend=attn,
+                k_scale=st.k_scale if quantized else None,
+                v_scale=st.v_scale if quantized else None,
+            )
+            scale_upd = (
+                {"k_scale": ks_full, "v_scale": vs_full} if quantized
+                else {}
             )
         else:
+            scale_upd = {}
             cache = KVCache(
                 k=jax.lax.dynamic_slice_in_dim(st.k, row0, Bs, axis=1),
                 v=jax.lax.dynamic_slice_in_dim(st.v, row0, Bs, axis=1),
@@ -1477,6 +1616,7 @@ def serve_verify(
         new = st._replace(
             k=k_full,
             v=v_full,
+            **scale_upd,
             kpos=jax.lax.dynamic_update_slice_in_dim(
                 st.kpos, pos_slot, row0, axis=0
             ),

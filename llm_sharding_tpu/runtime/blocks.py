@@ -78,6 +78,35 @@ class BlockAllocator:
         """Allocatable blocks (the trash block never counts)."""
         return self.num_blocks - 1
 
+    def bytes_per_block(
+        self, *, num_layers: int, num_kv_heads: int, head_dim: int,
+        kv_dtype,
+    ) -> int:
+        """Device bytes ONE arena block costs across all layers: K + V
+        codes (``2 × L × BS × Nkv × Dh × itemsize``) plus, for quantized
+        1-byte dtypes, the block's slice of the per-block-per-head f32
+        scale arenas (``2 × L × Nkv × 4``). This is the sizing primitive
+        behind the ``server_arena_bytes{dtype=...}`` gauge and the
+        capacity table in README — at equal HBM budget,
+        ``budget // bytes_per_block`` is how many blocks each dtype
+        admits (int8 ≈ 2× bf16)."""
+        item = np.dtype(kv_dtype).itemsize
+        kv = 2 * num_layers * self.block_size * num_kv_heads * head_dim * item
+        scales = 2 * num_layers * num_kv_heads * 4 if item == 1 else 0
+        return kv + scales
+
+    def arena_bytes(
+        self, *, num_layers: int, num_kv_heads: int, head_dim: int,
+        kv_dtype,
+    ) -> int:
+        """Total device bytes of this pool's arena (every block including
+        the reserved trash sink — the arrays exist whether or not a block
+        is allocatable)."""
+        return self.num_blocks * self.bytes_per_block(
+            num_layers=num_layers, num_kv_heads=num_kv_heads,
+            head_dim=head_dim, kv_dtype=kv_dtype,
+        )
+
     @property
     def num_free(self) -> int:
         return len(self._free)

@@ -74,7 +74,13 @@ class RadixNode:
     def __init__(self, key: np.ndarray, blocks, parent):
         self.key = np.asarray(key, np.int32)
         self.blocks: list[int] = list(blocks)
-        self.host_kv: Optional[tuple] = None  # (k, v) numpy when demoted
+        # Demoted: a tuple of numpy arrays, ALL with the block axis at
+        # position 2 — (k, v) for a plain arena, (k, v, k_scale, v_scale)
+        # for a quantized one (the owning server's read_kv decides; the
+        # tree only ever slices/concatenates along axis 2 and hands the
+        # tuple back to write_kv verbatim, so the round trip is byte-exact
+        # either way)
+        self.host_kv: Optional[tuple] = None
         self.children: dict[int, "RadixNode"] = {}
         self.parent: Optional["RadixNode"] = parent
         self.refs = 0  # live rows pinning this node (admission ↔ release)
@@ -310,10 +316,9 @@ class RadixCache:
         top = RadixNode(child.key[:at_tokens], child.blocks[:nb], parent)
         top.last_used = child.last_used
         if child.host_kv is not None:
-            k, v = child.host_kv
-            top.host_kv = (k[:, :, :nb], v[:, :, :nb])
+            top.host_kv = tuple(a[:, :, :nb] for a in child.host_kv)
             top.blocks = []
-            child.host_kv = (k[:, :, nb:], v[:, :, nb:])
+            child.host_kv = tuple(a[:, :, nb:] for a in child.host_kv)
         else:
             child.blocks = child.blocks[nb:]
         child.key = child.key[at_tokens:]
@@ -422,8 +427,9 @@ class RadixCache:
                     break
                 self._drop(host_leaves.pop(0))
             if self.host_blocks + nb <= self.host_pool_blocks:
-                k, v = self.read_kv(node.blocks)
-                node.host_kv = (np.asarray(k), np.asarray(v))
+                node.host_kv = tuple(
+                    np.asarray(a) for a in self.read_kv(node.blocks)
+                )
                 self.alloc.unmark_cached(node.blocks)
                 self.alloc.free(node.blocks)
                 node.blocks = []
@@ -438,15 +444,14 @@ class RadixCache:
         (evicting other cold nodes if needed), write the host copies back
         (bit-exact — same bytes out as in). False when the pool cannot
         free enough even after eviction."""
-        k, v = node.host_kv
-        nb = k.shape[2]
+        nb = node.host_kv[0].shape[2]
         if not self.ensure_free(nb):
             return False
         try:
             blocks = self.alloc.alloc(nb)
         except BlockExhausted:  # raced pinned-only pool state
             return False
-        self.write_kv(blocks, k, v)
+        self.write_kv(blocks, *node.host_kv)
         self.alloc.mark_cached(blocks)
         node.blocks = blocks
         node.host_kv = None
@@ -592,8 +597,10 @@ class RadixCache:
             })
             arrays[f"radix.{i}.key"] = np.asarray(n.key, np.int32)
             if not n.on_device():
-                arrays[f"radix.{i}.k"] = n.host_kv[0]
-                arrays[f"radix.{i}.v"] = n.host_kv[1]
+                # one entry per host-KV component — kv0/kv1 are K and V,
+                # quantized arenas add kv2/kv3 (the scale arenas)
+                for j, a in enumerate(n.host_kv):
+                    arrays[f"radix.{i}.kv{j}"] = a
         return {
             "nodes": nodes,
             "arrays": arrays,
@@ -619,10 +626,18 @@ class RadixCache:
             node = RadixNode(key, meta["blocks"], parent)
             node.last_used = int(meta["last_used"])
             if meta["tier"] == "host":
-                node.host_kv = (
-                    np.asarray(arrays[f"radix.{i}.k"]),
-                    np.asarray(arrays[f"radix.{i}.v"]),
-                )
+                if f"radix.{i}.kv0" in arrays:
+                    parts = []
+                    while f"radix.{i}.kv{len(parts)}" in arrays:
+                        parts.append(
+                            np.asarray(arrays[f"radix.{i}.kv{len(parts)}"])
+                        )
+                    node.host_kv = tuple(parts)
+                else:  # pre-kv-quant (format-3) snapshot keys
+                    node.host_kv = (
+                        np.asarray(arrays[f"radix.{i}.k"]),
+                        np.asarray(arrays[f"radix.{i}.v"]),
+                    )
                 node.blocks = []
                 self.host_blocks += key.shape[0] // self.block_size
             else:

@@ -815,6 +815,8 @@ class ReplicatedServer:
                 if s.paged:
                     entry["kv_blocks_in_use"] = s._alloc.in_use
                     entry["kv_blocks_total"] = s._alloc.capacity_blocks
+                    entry["kv_dtype"] = s.kv_dtype
+                    entry["arena_bytes"] = s.arena_bytes_device
                 pc = s.prefix_cache_stats()
                 if pc is not None:
                     # per-replica hit rate + host-tier occupancy: the radix

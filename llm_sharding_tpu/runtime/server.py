@@ -74,7 +74,8 @@ import jax
 import jax.numpy as jnp
 
 from ..obs.metrics import (
-    ATTN_BACKEND, ATTN_BACKENDS, ATTN_BLOCKS_READ, DEFAULT_RATE_BUCKETS,
+    ARENA_BYTES, ATTN_BACKEND, ATTN_BACKENDS, ATTN_BLOCKS_READ,
+    DEFAULT_RATE_BUCKETS,
     KV_BLOCKS_IN_USE, KV_BLOCKS_TOTAL, KV_HOST_TIER_BLOCKS, KV_WASTE_FRAC,
     PREFIX_HIT_RATE, PREFIX_HIT_TOKENS, REGISTRY, record_shape_key,
 )
@@ -174,10 +175,13 @@ def _update_load_gauges() -> None:
     ``server_kv_waste_frac`` — ``obs/metrics.py``), summed over live PAGED
     servers: waste is 1 − live tokens / allocated token slots, the
     fragmentation the operator tunes ``kv_block_size`` against."""
+    from ..ops.quant import KV_DTYPES
+
     queued = active = 0
     kv_total = kv_used = kv_slots = kv_live = 0
     host_blocks = hit_tok = elig_tok = 0
     backends = dict.fromkeys(ATTN_BACKENDS, 0)
+    arena_bytes = dict.fromkeys(KV_DTYPES, 0)
     for s in list(_LIVE_SERVERS):
         queued += len(s._queue)
         active += sum(r is not None and not r.done for r in s._rows)
@@ -190,6 +194,8 @@ def _update_load_gauges() -> None:
         if getattr(s, "paged", False):
             kv_total += s._alloc.capacity_blocks
             kv_used += s._alloc.in_use
+            if not getattr(s, "_closed", False):
+                arena_bytes[s.kv_dtype] += s.arena_bytes_device
             # COLD prefix-cache blocks (tree-held, no row mapping them) are
             # reusable capacity, not allocation: counting them in the waste
             # denominator would misreport a healthy warm cache as leaked
@@ -211,6 +217,8 @@ def _update_load_gauges() -> None:
     _M_ACTIVE.set(active)
     for b, n in backends.items():
         ATTN_BACKEND.labels(backend=b).set(n)
+    for name, nbytes in arena_bytes.items():
+        ARENA_BYTES.labels(dtype=name).set(nbytes)
     KV_BLOCKS_TOTAL.set(kv_total)
     KV_BLOCKS_IN_USE.set(kv_used)
     KV_HOST_TIER_BLOCKS.set(host_blocks)
@@ -864,6 +872,7 @@ class PipelineServer:
         snapshot_path: Optional[str] = None,
         kv_block_size: Optional[int] = None,
         kv_blocks: Optional[int] = None,
+        kv_dtype: str = "bf16",
         paged_attn: str = "auto",
         prefix_cache: str = "off",
         host_pool_blocks: int = 0,
@@ -966,6 +975,45 @@ class PipelineServer:
                 )
         self.kv_block_size = kv_block_size
         self.kv_blocks = kv_blocks
+        # -- quantized KV arena (--kv-dtype; ops/quant KV section) ---------
+        # "bf16" (the default) stores the arena in the engine's compute
+        # cache dtype — the pre-existing exact path. "int8"/"fp8" store
+        # 1-byte codes with per-block-per-head scales in a parallel scale
+        # arena: ~2× the blocks at equal HBM and half the decode-attention
+        # DMA bytes, at a bounded greedy-token drift (the FIRST
+        # intentionally non-bit-exact serve variant — gate rollouts on the
+        # bench's kv-quant token-match fraction).
+        from ..ops.quant import (
+            KV_DTYPES, fp8_kv_supported, is_kv_quantized, kv_storage_dtype,
+        )
+
+        if kv_dtype not in KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype must be one of {KV_DTYPES}, got {kv_dtype!r}"
+            )
+        if kv_dtype != "bf16" and not self.paged:
+            raise ValueError(
+                f"kv_dtype={kv_dtype!r} needs paged KV serving (set "
+                "kv_block_size/kv_blocks): quantization scales live per "
+                "arena block — dense per-row reservations have no blocks"
+            )
+        if kv_dtype != "bf16" and self.tp > 1:
+            raise NotImplementedError(
+                f"kv_dtype={kv_dtype!r} with tensor_parallel={self.tp}: "
+                "the per-block-per-head scale arenas are not heads-sharded "
+                "yet — serve quantized KV on pp (or dp×pp) meshes, or keep "
+                "kv_dtype='bf16' under tp"
+            )
+        if kv_dtype == "fp8" and not fp8_kv_supported():
+            raise ValueError(
+                "kv_dtype='fp8': this jax backend cannot round-trip "
+                "float8_e4m3fn arrays — use kv_dtype='int8'"
+            )
+        self.kv_dtype = kv_dtype
+        #: the arena STORAGE dtype (engine.cache_dtype stays the compute
+        #: dtype — prefill windows, prefix handles and dense state use it)
+        self.kv_store_dtype = kv_storage_dtype(kv_dtype, engine.cache_dtype)
+        self.kv_quantized = is_kv_quantized(self.kv_store_dtype)
         # -- paged attention backend (ops/paged_attention dispatch) --------
         # Which implementation the serve programs' decode attention runs:
         # "kernel" (the Pallas paged kernel — streams only each row's
@@ -1076,7 +1124,10 @@ class PipelineServer:
             Lp,
             capacity=capacity + self._spec_cols,
             batch_per_slot=batch_per_slot,
-            cache_dtype=engine.cache_dtype,
+            # the ARENA dtype: int8/fp8 codes under kv quantization (the
+            # compute dtype stays engine.cache_dtype — prefill windows and
+            # prefix handles dequantize into it)
+            cache_dtype=self.kv_store_dtype,
             act_dtype=act_dtype,
             tp=self.tp,
             kv_blocks=self.kv_blocks or 0,
@@ -1089,6 +1140,16 @@ class PipelineServer:
 
             self._alloc: Optional[BlockAllocator] = BlockAllocator(
                 self.kv_blocks, self.kv_block_size
+            )
+            # device bytes of the pooled arena (codes + scale arenas),
+            # published as server_arena_bytes{dtype=} by the gauge sweep —
+            # the observable side of the --kv-dtype capacity claim. Padded
+            # pipeline layers count (their arena rows are allocated).
+            self.arena_bytes_device = self._alloc.arena_bytes(
+                num_layers=self.num_stages * Lp,
+                num_kv_heads=self.cfg.num_key_value_heads,
+                head_dim=self.cfg.head_dim_,
+                kv_dtype=self.kv_store_dtype,
             )
             # host mirror of the device block tables (all-trash at birth);
             # _push_tables ships it whole — [M, T] int32 is a few hundred
@@ -1176,11 +1237,16 @@ class PipelineServer:
         shapes and the exact XLA gather elsewhere; the PAGED_FORCE_KERNEL
         env var overrides ``auto`` only (an explicit choice wins), which is
         how CI pins ``interpret`` across a whole test run."""
-        from ..ops.paged_attention import forced_backend, kernel_eligible
+        from ..ops.paged_attention import (
+            forced_backend, kernel_eligible, kernel_sublane,
+        )
 
         on_tpu = jax.default_backend() == "tpu"
+        # eligibility keys on the STORAGE dtype: a 1-byte (int8/fp8) arena
+        # tiles at sublane 32, so --kv-dtype int8 wants kv_block_size a
+        # multiple of 32 where bf16 needed 16
         eligible = kernel_eligible(
-            self.cfg.head_dim_, self.kv_block_size, self.engine.cache_dtype
+            self.cfg.head_dim_, self.kv_block_size, self.kv_store_dtype
         )
 
         def check_kernel(source: str) -> None:
@@ -1192,14 +1258,17 @@ class PipelineServer:
                     f"code path off-TPU, or paged_attn='xla'"
                 )
             if not eligible:
+                sublane = kernel_sublane(self.kv_store_dtype)
                 raise ValueError(
                     f"{source}: head_dim={self.cfg.head_dim_} / "
                     f"kv_block_size={self.kv_block_size} are not "
-                    f"Mosaic-eligible for cache dtype "
-                    f"{jnp.dtype(self.engine.cache_dtype).name} (head_dim "
-                    f"must be a multiple of 128 and the block size a "
-                    f"sublane multiple — see "
-                    f"ops/paged_attention.kernel_eligible); use "
+                    f"Mosaic-eligible for KV storage dtype "
+                    f"{jnp.dtype(self.kv_store_dtype).name} "
+                    f"(kv_dtype={self.kv_dtype!r}): head_dim must be a "
+                    f"multiple of 128 and the block size a multiple of "
+                    f"the dtype's sublane count ({sublane} for "
+                    f"{jnp.dtype(self.kv_store_dtype).name}) — see "
+                    f"ops/paged_attention.kernel_eligible; use "
                     f"paged_attn='auto' or 'xla'"
                 )
 
@@ -1453,10 +1522,14 @@ class PipelineServer:
                 return d
 
             return {
-                # format 3: adds the prefix-cache section (radix tree +
-                # host-tier KV) and its serve kwargs; formats 1 (dense) and
-                # 2 (paged, no cache) still restore — see ``restore``
-                "format": 3,
+                # format 4: adds kv_dtype to serve_kwargs, the scale-arena
+                # state leaves and the radix host-KV component keys
+                # (radix.{i}.kv{j}) — bumped so a PRE-kv-quant reader's
+                # format gate refuses cleanly instead of crashing on the
+                # unknown kwarg. Format 3 added the prefix-cache section;
+                # formats 1 (dense), 2 (paged, no cache) and 3 still
+                # restore — see ``restore``
+                "format": 4,
                 "radix": (
                     None if self._radix is None else self._radix.snapshot()
                 ),
@@ -1474,6 +1547,11 @@ class PipelineServer:
                     default_deadline_s=self.default_deadline_s,
                     kv_block_size=self.kv_block_size,
                     kv_blocks=self.kv_blocks,
+                    # KV storage dtype rides the checkpoint: a quantized
+                    # snapshot's arena bytes ARE codes — restoring them
+                    # into a bf16 server would reinterpret garbage (the
+                    # dtype check below catches a hand-edited mismatch)
+                    kv_dtype=self.kv_dtype,
                     # the REQUESTED backend, not the resolved impl: an
                     # operator's explicit kernel/xla pin survives restore
                     # (snapshot-wins, like every serve kwarg), while
@@ -1520,7 +1598,7 @@ class PipelineServer:
         of an unsupported model family, raises the curated
         ``NotImplementedError`` instead of an obscure mesh/sharding error
         deep in the first dispatched program."""
-        if snap.get("format") not in (1, 2, 3):
+        if snap.get("format") not in (1, 2, 3, 4):
             raise ValueError(f"unknown snapshot format {snap.get('format')!r}")
         validate = getattr(engine, "_validate_serve", None)
         if validate is not None:
@@ -1548,6 +1626,16 @@ class PipelineServer:
             # placeholder leaf restores as all-trash zeros
             host["block_tables"] = np.zeros(
                 tuple(srv.state.block_tables.shape), np.int32
+            )
+        if "k_scale" not in host:
+            # pre-kv-quant snapshot: necessarily unquantized (kv_dtype
+            # defaulted to "bf16" above), so the scale leaves restore as
+            # their zero placeholders
+            host["k_scale"] = np.zeros(
+                tuple(srv.state.k_scale.shape), np.float32
+            )
+            host["v_scale"] = np.zeros(
+                tuple(srv.state.v_scale.shape), np.float32
             )
         # capture (shape, dtype, sharding) then FREE the zeroed template
         # before the device_put — otherwise restore transiently holds two
@@ -1896,7 +1984,7 @@ class PipelineServer:
             "serve_chunk",
             (self.num_stages, self.batch_per_slot, self.capacity,
              cycles, self._sampling, self._filtering, self.tp,
-             self.kv_block_size, attn),
+             self.kv_block_size, attn, self.kv_dtype),
         )
 
         def do_chunk():
@@ -2375,19 +2463,41 @@ class PipelineServer:
 
     def _read_arena_blocks(self, blocks) -> tuple:
         """Device→host copy of arena blocks (radix host-tier demotion).
-        Returns (k, v) numpy ``[S, Lp, nb, BS, Nkv, Dh]`` in the cache
-        dtype — the exact bytes ``_write_arena_blocks`` later restores."""
+        Returns (k, v) numpy ``[S, Lp, nb, BS, Nkv, Dh]`` in the ARENA
+        dtype — the exact bytes ``_write_arena_blocks`` later restores. A
+        quantized arena returns (k, v, k_scale, v_scale): the codes demote
+        verbatim with their per-block scales, so the host tier holds twice
+        the cached tokens per host-RAM byte too (the radix tree slices
+        every component along its block axis 2 and never interprets
+        them)."""
         idx = jnp.asarray(np.asarray(list(blocks), np.int32))
         k = np.asarray(jnp.take(self.state.k, idx, axis=2))
         v = np.asarray(jnp.take(self.state.v, idx, axis=2))
-        return k, v
+        if not self.kv_quantized:
+            return k, v
+        ks = np.asarray(jnp.take(self.state.k_scale, idx, axis=2))
+        vs = np.asarray(jnp.take(self.state.v_scale, idx, axis=2))
+        return k, v, ks, vs
 
-    def _write_arena_blocks(self, blocks, k_host, v_host) -> None:
+    def _write_arena_blocks(self, blocks, k_host, v_host, *scales) -> None:
         """Host→device restore of demoted blocks into freshly allocated
         arena slots (donating scatter — the arena never transiently
         doubles). Dispatch order makes it safe: the write precedes any
-        program that could attend the restored blocks."""
+        program that could attend the restored blocks. Quantized arenas
+        restore the scale components alongside the codes, byte-exact."""
         idx = jnp.asarray(np.asarray(list(blocks), np.int32))
+        if self.kv_quantized:
+            ks_host, vs_host = scales
+            k_new, v_new, ks_new, vs_new = serve_ops.write_arena_blocks_q(
+                self.state.k, self.state.v,
+                self.state.k_scale, self.state.v_scale, idx,
+                jnp.asarray(k_host), jnp.asarray(v_host),
+                jnp.asarray(ks_host), jnp.asarray(vs_host),
+            )
+            self.state = self.state._replace(
+                k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new
+            )
+            return
         k_new, v_new = serve_ops.write_arena_blocks(
             self.state.k, self.state.v, idx,
             jnp.asarray(k_host), jnp.asarray(v_host),
@@ -3212,6 +3322,22 @@ class PipelineServer:
                         self.mesh, self.state.k, self.state.v,
                         jnp.asarray(np.asarray(rplan.blocks, np.int32)),
                         self.kv_block_size, tp=self.tp,
+                        # quantized arenas: the handle carries the blocks
+                        # DEQUANTIZED into the compute dtype; the admission
+                        # scatter requantizes (near-lossless — the values
+                        # are exact code multiples of the stored scale)
+                        k_scale=(
+                            self.state.k_scale if self.kv_quantized
+                            else None
+                        ),
+                        v_scale=(
+                            self.state.v_scale if self.kv_quantized
+                            else None
+                        ),
+                        out_dtype=(
+                            self.engine.cache_dtype if self.kv_quantized
+                            else None
+                        ),
                     )
                     pn, spx_key = spx_n, spx_n
                 else:
@@ -3220,7 +3346,7 @@ class PipelineServer:
                     "serve_admit",
                     (self.num_stages, Bs, self.capacity, bucket, is_emb,
                      spx_key, self._filtering,
-                     self.tp, self.kv_block_size, carried),
+                     self.tp, self.kv_block_size, carried, self.kv_dtype),
                 )
                 self.state, tok0 = serve_ops.serve_admit(
                     self.cfg,
@@ -3334,6 +3460,7 @@ class PipelineServer:
                 self.num_stages,
                 tp=self.tp,
                 block_size=self.kv_block_size or 0,
+                cache_dtype=self.engine.cache_dtype,
             )
             # interleave only when some OTHER request is mid-decode — the
             # admitting rows themselves are in _rows already and must not
@@ -3443,7 +3570,8 @@ class PipelineServer:
             record_shape_key(
                 "serve_verify",
                 (self.num_stages, Bs, self.capacity, K, self._sampling,
-                 self._filtering, self.tp, self.kv_block_size, attn),
+                 self._filtering, self.tp, self.kv_block_size, attn,
+                 self.kv_dtype),
             )
             def do_verify(slot=slot, draft=draft, draft_len=draft_len,
                           cache_delta=cache_delta):

@@ -247,6 +247,23 @@ def test_serve_paged_cli(shards, capsys, monkeypatch):
         "--prefix-cache", "hbm",
     ])
     assert radix == dense
+    # the quantized arena serves from the CLI too (int8 is drift-tolerant
+    # by contract, so only completion shape is asserted — token parity
+    # belongs to tests/test_kv_quant.py's harness)
+    q8 = run([
+        "--kv-block-size", "16", "--kv-blocks", "40",
+        "--kv-dtype", "int8",
+    ])
+    assert len(q8) == 2
+
+
+def test_serve_kv_dtype_flag_fast_fails(shards, capsys):
+    """--kv-dtype int8 without the paged KV flags fails in milliseconds,
+    before model load (same pre-load pattern as the kv flag pairing)."""
+    rc = cli.main(["serve", shards, "--kv-dtype", "int8"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "--kv-dtype" in err and "--kv-block-size" in err
 
 
 def test_serve_prefix_cache_flag_fast_fails(shards, capsys):

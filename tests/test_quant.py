@@ -50,8 +50,14 @@ def test_qmatmul_matches_dequantized_matmul():
     w = jax.random.normal(jax.random.key(2), (64, 48), jnp.float32)
     qt = quantize_tensor(w)
     got = qmatmul(x, qt)
-    want = x @ dequantize(qt)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5)
+    want = jnp.matmul(x, dequantize(qt), precision=jax.lax.Precision.HIGHEST)
+    # the two paths apply the per-column scale on opposite sides of the dot
+    # (factored out vs folded into the operand), so the float reassociation
+    # drifts a few ulp on CPU matmuls — tolerance sized well below the int8
+    # quantization step itself (absmax/127 ≈ 8e-3 relative), not at exactness
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-4, atol=2e-6
+    )
     # raw arrays pass through
     np.testing.assert_array_equal(np.asarray(qmatmul(x, w)), np.asarray(x @ w))
 
