@@ -762,6 +762,129 @@ def bench_failover_serve(on_tpu, cfg, params, jax, jnp):
     )
 
 
+def bench_disagg_serve(on_tpu, cfg, params, jax, jnp):
+    """Disaggregated prefill/decode serving (runtime/disagg.py) vs unified
+    dp2 on a MIXED workload: interactive short-prompt streams decoding
+    while long-prefill requests arrive. Unified replicas interleave the
+    long prefills with every live stream's decode (ITL spikes exactly when
+    the big prompts land); the disaggregated split prefills them on the
+    prefill replica and ships block-granular KV to the decode replica, so
+    the interactive streams' inter-token latency never sees a stranger's
+    prefill. Emits the disagg decode ITL p99 (headline, lower is better;
+    vs_baseline = unified/disagg ITL ratio, >1 means disagg wins) with
+    TTFT p50 for both modes, and asserts IN-BAND that the disaggregated
+    greedy output is token-identical to the unified run."""
+    from llm_sharding_tpu.obs.metrics import DISAGG_HANDOFFS
+    from llm_sharding_tpu.runtime.disagg import DisaggServer
+    from llm_sharding_tpu.runtime.replicated import ReplicatedServer
+
+    name = (
+        "serve_disagg_itl_llama3.2-3b_dp2" if on_tpu
+        else "serve_disagg_itl_tiny_cpu"
+    )
+    if on_tpu:
+        stages, n_int, n_long = 1, 12, 4
+        int_len, long_len, max_new = 32, 1024, 96
+        cap, bs, blocks = 2048, 64, 4 * 2048 // 64
+    else:
+        stages, n_int, n_long = 2, 4, 2
+        int_len, long_len, max_new = 6, 48, 12
+        cap, bs, blocks = 128, 8, 4 * 128 // 8
+    n_dev = len(jax.devices())
+    if n_dev < 2 * stages:
+        emit_error(name, "ms",
+                   f"needs >= {2 * stages} devices for dp2 x {stages} "
+                   f"stage(s) (have {n_dev})")
+        return
+    devices = jax.devices()[: 2 * stages]
+    rng = np.random.default_rng(17)
+    int_prompts = [
+        rng.integers(0, cfg.vocab_size, int_len).astype(np.int32)
+        for _ in range(n_int)
+    ]
+    long_prompts = [
+        rng.integers(0, cfg.vocab_size, long_len).astype(np.int32)
+        for _ in range(n_long)
+    ]
+
+    def run(disagg):
+        kw = dict(
+            data_parallel=2, num_stages=stages, devices=devices,
+            capacity=cap, kv_block_size=bs, kv_blocks=blocks,
+            prefix_cache="hbm",
+        )
+        srv = (
+            DisaggServer(cfg, params, roles=["prefill", "decode"], **kw)
+            if disagg else ReplicatedServer(cfg, params, **kw)
+        )
+        ints = [srv.submit(p, max_new_tokens=max_new) for p in int_prompts]
+        # let every interactive stream reach STEADY decode before the
+        # long prefills land: first tokens out AND (disagg) hand-offs
+        # settled — the measured window is the interference the split is
+        # supposed to remove, not the one-time hand-off gap (that cost is
+        # visible in tok_s and the unified-vs-disagg TTFT figures)
+        while not all(r.tokens for r in ints) or (
+            disagg and srv._pending_handoff
+        ):
+            srv.step()
+        longs = [srv.submit(p, max_new_tokens=max_new) for p in long_prompts]
+        last_n = {id(r): len(r.tokens) for r in ints}
+        last_t = {id(r): time.perf_counter() for r in ints}
+        itl = []
+        t0 = time.perf_counter()
+        while not all(r.done for r in ints + longs):
+            srv.step()
+            now = time.perf_counter()
+            for r in ints:
+                n = len(r.tokens)
+                if n > last_n[id(r)]:
+                    itl.append((now - last_t[id(r)]) / (n - last_n[id(r)]))
+                    last_n[id(r)], last_t[id(r)] = n, now
+        dt = time.perf_counter() - t0
+        reqs = ints + longs
+        assert all(r.error is None for r in reqs), [
+            (r.id, r.error) for r in reqs if r.error is not None
+        ]
+        toks = [list(r.tokens) for r in reqs]
+        ttft = [r.first_token_at - r.submitted_at for r in reqs]
+        tok_s = sum(len(t) for t in toks) / dt
+        srv.close()
+        del srv
+        gc.collect()
+        return toks, np.asarray(itl), np.asarray(ttft), tok_s
+
+    run(False)  # compile the unified programs
+    run(True)   # compile the disagg-only variants (radix-hit admissions)
+    uni_toks, uni_itl, uni_ttft, uni_tok_s = run(False)
+    h0 = DISAGG_HANDOFFS.labels(outcome="ok").value
+    dis_toks, dis_itl, dis_ttft, dis_tok_s = run(True)
+    handoffs = int(DISAGG_HANDOFFS.labels(outcome="ok").value - h0)
+    if dis_toks != uni_toks:
+        # the whole point of the hand-off path is exactness — a divergent
+        # headline must not ship
+        raise RuntimeError(
+            "disaggregated serve output diverged from the unified run "
+            f"({sum(len(t) for t in dis_toks)} vs "
+            f"{sum(len(t) for t in uni_toks)} tokens)"
+        )
+    dis_p99 = float(np.percentile(dis_itl, 99)) * 1e3
+    uni_p99 = float(np.percentile(uni_itl, 99)) * 1e3
+    emit(
+        name, dis_p99, "ms", uni_p99 / max(dis_p99, 1e-9),
+        unified_itl_p99_ms=round(uni_p99, 2),
+        itl_p50_ms=round(float(np.percentile(dis_itl, 50)) * 1e3, 2),
+        unified_itl_p50_ms=round(float(np.percentile(uni_itl, 50)) * 1e3, 2),
+        ttft_p50_ms=round(float(np.percentile(dis_ttft, 50)) * 1e3, 2),
+        unified_ttft_p50_ms=round(
+            float(np.percentile(uni_ttft, 50)) * 1e3, 2
+        ),
+        tok_s=round(dis_tok_s, 2),
+        unified_tok_s=round(uni_tok_s, 2),
+        handoffs=handoffs,
+        token_identical=(dis_toks == uni_toks),
+    )
+
+
 def bench_paged_serve(on_tpu, engine):
     """Paged KV serving (runtime/blocks.py + ops/paged_attention.py) on a
     SKEWED-length workload at EQUAL HBM budget. Dense reserves ``capacity``
@@ -1543,6 +1666,10 @@ def main():
         "serve_overload_goodput_llama3.2-3b_1stage" if on_tpu
         else "serve_overload_goodput_tiny_cpu"
     )
+    ndisagg = (
+        "serve_disagg_itl_llama3.2-3b_dp2" if on_tpu
+        else "serve_disagg_itl_tiny_cpu"
+    )
 
     # section order = survival priority under a driver-side timeout:
     # 3B (anchor emitted immediately) → serve → 3B-int8 → pallas → 7B(+int8)
@@ -1670,6 +1797,16 @@ def main():
             except Exception as e:  # noqa: BLE001
                 emit_error(nfailover, "tokens/sec", e)
             gc.collect()
+        # disaggregated prefill/decode (dp2 roles + KV hand-off) builds its
+        # own replica engines from params3b too — also before int8 donates
+        if remaining() < 180:
+            emit_skip(ndisagg, "ms", 180)
+        else:
+            try:
+                bench_disagg_serve(on_tpu, cfg3b, params3b, jax, jnp)
+            except Exception as e:  # noqa: BLE001
+                emit_error(ndisagg, "ms", e)
+            gc.collect()
         del serve_engine
         gc.collect()
         # speculative decode BEFORE int8: it reuses the live bf16 device
@@ -1736,6 +1873,7 @@ def main():
         emit_error(nradix, "tokens/sec", "not attempted: 3B section failed")
         emit_error(nfailover, "tokens/sec",
                    "not attempted: 3B section failed")
+        emit_error(ndisagg, "ms", "not attempted: 3B section failed")
         emit_error(nprefix, "x_speedup_vs_full_prefill",
                    "not attempted: 3B section failed")
         emit_error(nspec, "tokens/sec", "not attempted: 3B section failed")

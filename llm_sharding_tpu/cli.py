@@ -464,6 +464,82 @@ def cmd_serve(args) -> int:
             file=sys.stderr,
         )
         return 2
+    # -- disaggregated serving flags: fail fast, before model load ---------
+    disagg = getattr(args, "disagg", False)
+    roles = None
+    planner = None
+    if (getattr(args, "prefill_replicas", 0) or getattr(args, "roles", None)
+            or getattr(args, "profile_json", None)) and not disagg:
+        print(
+            "error: --prefill-replicas/--roles/--profile-json need --disagg",
+            file=sys.stderr,
+        )
+        return 2
+    if disagg:
+        dp = getattr(args, "data_parallel", 1)
+        if dp < 2:
+            print(
+                "error: --disagg needs --data-parallel >= 2 (prefill and "
+                "decode pools each need at least one replica group)",
+                file=sys.stderr,
+            )
+            return 2
+        if not args.kv_block_size:
+            print(
+                "error: --disagg needs paged KV serving "
+                "(--kv-block-size/--kv-blocks): the hand-off engine "
+                "streams arena blocks between replicas",
+                file=sys.stderr,
+            )
+            return 2
+        if getattr(args, "prefix_cache", "off") == "off":
+            print(
+                "error: --disagg needs --prefix-cache hbm or host: the "
+                "hand-off lands streamed KV in the decode replica's radix "
+                "tree so adoption skips re-prefill",
+                file=sys.stderr,
+            )
+            return 2
+        if getattr(args, "prefill_replicas", 0) and getattr(
+            args, "roles", None
+        ):
+            print(
+                "error: --prefill-replicas and --roles are mutually "
+                "exclusive",
+                file=sys.stderr,
+            )
+            return 2
+        if getattr(args, "prefill_replicas", 0) and not (
+            1 <= args.prefill_replicas <= dp - 1
+        ):
+            print(
+                f"error: --prefill-replicas must be in [1, "
+                f"{dp - 1}] (both sides need at least one replica), got "
+                f"{args.prefill_replicas}",
+                file=sys.stderr,
+            )
+            return 2
+        if getattr(args, "roles", None):
+            roles = [r.strip() for r in args.roles.split(",")]
+            from .obs.metrics import REPLICA_ROLES
+
+            if len(roles) != dp or any(
+                r not in REPLICA_ROLES for r in roles
+            ):
+                print(
+                    f"error: --roles needs {dp} comma-separated values "
+                    f"from {REPLICA_ROLES}, got {args.roles!r}",
+                    file=sys.stderr,
+                )
+                return 2
+        if getattr(args, "profile_json", None):
+            from .runtime.placement import PlacementPlanner
+
+            try:
+                planner = PlacementPlanner.from_json(args.profile_json)
+            except (OSError, ValueError, KeyError, TypeError) as e:
+                print(f"error: bad --profile-json: {e}", file=sys.stderr)
+                return 2
     if getattr(args, "tenants_config", None):
         # fail a malformed tenants file in milliseconds, not after model load
         from .runtime.fairness import load_tenants_config
@@ -493,9 +569,25 @@ def cmd_serve(args) -> int:
 
         cfg, params = shard_store.load_full(args.shards, dtype=_dtype(args.dtype))
         placement = _placement(args, cfg.num_hidden_layers)
-        srv = ReplicatedServer(
+        if disagg:
+            from .runtime.disagg import DisaggServer
+
+            cls = DisaggServer
+            disagg_kw = dict(
+                roles=roles,
+                prefill_replicas=(
+                    getattr(args, "prefill_replicas", 0) or
+                    (1 if roles is None else None)
+                ),
+                planner=planner,
+            )
+        else:
+            cls = ReplicatedServer
+            disagg_kw = {}
+        srv = cls(
             cfg, params,
             data_parallel=args.data_parallel,
+            **disagg_kw,
             num_stages=None if placement else getattr(args, "stages", None),
             tensor_parallel=getattr(args, "tensor_parallel", 1),
             placement=placement,
@@ -521,10 +613,22 @@ def cmd_serve(args) -> int:
             min_replicas=getattr(args, "min_replicas", 1),
         )
         eng = srv.engines[0]
+        extra = ""
+        if disagg:
+            extra = (
+                " [disagg roles: "
+                + ",".join(
+                    srv.roles[d] for d in sorted(srv.roles)
+                )
+                + (", planner: profile.json fits" if planner is not None
+                   else ", planner: none (load routing)")
+                + "]"
+            )
         print(
             f"serving {eng.cfg.model_type}: {args.data_parallel} replicas x "
-            f"{eng.mesh.shape} (capacity={args.capacity}); enter a prompt, "
-            "^D to exit; :drain N / :spawn resize the replica set live",
+            f"{eng.mesh.shape} (capacity={args.capacity}){extra}; enter a "
+            "prompt, ^D to exit; :drain N / :spawn resize the replica set "
+            "live",
             file=sys.stderr,
         )
     else:
@@ -1373,6 +1477,39 @@ def build_parser() -> argparse.ArgumentParser:
         help="append one JSONL line per span (admit/chunk/apply/request) to "
         "this file for offline latency analysis; with --data-parallel each "
         "replica writes PATH.r<i>",
+    )
+    s.add_argument(
+        "--disagg", action="store_true",
+        help="disaggregated prefill/decode serving (with --data-parallel "
+        ">= 2, --kv-block-size/--kv-blocks and --prefix-cache): replicas "
+        "get a role — prefill replicas admit fresh requests and stream "
+        "each request's KV blocks to a decode replica after its first "
+        "token, so long prefills never stall live streams' inter-token "
+        "latency. The decode side resumes through the arena-gathered "
+        "radix prefix (zero re-prefill FLOPs), token-identical to "
+        "unified serving. Default split: 1 prefill replica, rest decode "
+        "(override with --prefill-replicas or --roles)",
+    )
+    s.add_argument(
+        "--prefill-replicas", type=int, default=0, dest="prefill_replicas",
+        help="with --disagg: the first N replica groups take the prefill "
+        "role, the rest decode (1 <= N <= replicas-1)",
+    )
+    s.add_argument(
+        "--roles", default=None,
+        help="with --disagg: explicit comma-separated per-replica roles, "
+        "one of prefill/decode/unified per replica group, e.g. "
+        "'prefill,decode,decode' (mutually exclusive with "
+        "--prefill-replicas)",
+    )
+    s.add_argument(
+        "--profile-json", default=None, dest="profile_json",
+        help="with --disagg: a 'profile' command's profile.json (or its "
+        "directory). The planner consumes the fitted prefill/decode "
+        "latency models to route each request to the replica minimizing "
+        "predicted TTFT (folding in radix-cache warmth) and to choose "
+        "the prefill:decode ratio for the offered mix; without it the "
+        "router falls back to health/warmth/load routing",
     )
     s.set_defaults(fn=cmd_serve)
 

@@ -553,6 +553,65 @@ def set_replica_state(replica, state: str) -> None:
         REPLICA_STATE.labels(replica=r, state=s).set(1.0 if s == state else 0.0)
 
 
+# -- disaggregated prefill/decode serving (runtime/disagg.py) ---------------
+# Defined here like the replica metrics: the families exist — and show 0 —
+# before the first DisaggServer is constructed.
+DISAGG_HANDOFFS = REGISTRY.counter(
+    "server_disagg_handoffs_total",
+    "Prefill→decode request hand-offs, by outcome (ok = KV blocks streamed "
+    "and the decode replica resumed through the arena-gathered prefix — "
+    "zero re-prefill FLOPs; cold = adopted without streamable KV (the "
+    "decode side re-prefills, token-identically); retried = a transient "
+    "kv_handoff fault deferred the hand-off one sweep; fallback = a "
+    "permanent fault or refused adopt left the request decoding where the "
+    "supervision layer could place it; no_target = no decode-capable "
+    "replica live, the request keeps decoding on its prefill replica; "
+    "failed = no replica could adopt the extracted request — it fails "
+    "typed)",
+    labels=("outcome",),
+)
+HANDOFF_BYTES = REGISTRY.counter(
+    "server_handoff_bytes_total",
+    "Host bytes of KV block data streamed between replicas (prefill→decode "
+    "hand-offs and cross-replica radix fills; quantized arenas stream "
+    "codes + scales, so the figure reflects the wire cost, not the "
+    "logical bf16 size)",
+)
+#: Replica roles in a disaggregated deployment: ``prefill`` replicas admit
+#: fresh requests and hand their KV off after the first token, ``decode``
+#: replicas resume them, ``unified`` replicas do both (the classic mode).
+REPLICA_ROLES = ("prefill", "decode", "unified")
+REPLICA_ROLE = REGISTRY.gauge(
+    "server_replica_role",
+    "Per-replica serving role, one-hot per replica label (the replica "
+    "label is the device-group index): exactly one role is 1 for each "
+    "replica of a disaggregated router; role assignment survives "
+    "drain/spawn cycles on the group",
+    labels=("replica", "role"),
+)
+
+
+def set_replica_role(replica, role: str) -> None:
+    """One-hot flip of ``server_replica_role`` for one replica label (the
+    role analogue of ``set_replica_state``)."""
+    if role not in REPLICA_ROLES:
+        raise ValueError(
+            f"unknown replica role {role!r}; expected one of {REPLICA_ROLES}"
+        )
+    r = str(replica)
+    for x in REPLICA_ROLES:
+        REPLICA_ROLE.labels(replica=r, role=x).set(1.0 if x == role else 0.0)
+
+
+DISAGG_TTFT_ERROR = REGISTRY.gauge(
+    "server_disagg_ttft_error",
+    "Relative |predicted − observed| / observed TTFT of the most recent "
+    "planner-routed request: how well the profiler's fitted latency "
+    "models track the live system (persistently high error means the "
+    "profile.json was fitted on different hardware or load)",
+)
+
+
 # -- production ingress (runtime/ingress.py + runtime/fairness.py) ---------
 # Defined here like the replica metrics: the families exist — and show 0 —
 # on /statz before the first IngressServer is constructed.

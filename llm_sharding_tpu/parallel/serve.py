@@ -533,7 +533,7 @@ def cancel_rows_batched(state: ServeState, rows, n_rows: int) -> ServeState:
     jax.jit,
     static_argnames=(
         "cfg", "mesh", "num_stages", "cache_dtype", "filtering", "tp",
-        "block_size",
+        "block_size", "prefix_in_arena",
     ),
     donate_argnums=(5,),  # the previous ServeState buffers are dead on
     # return (the server reassigns self.state) — donation halves the
@@ -566,6 +566,8 @@ def serve_admit(
     #   the key-chain note below
     tp: int = 1,  # static: tensor-parallel degree (megatron-sharded heads)
     block_size: int = 0,  # static: paged-KV block size (0 = dense state)
+    prefix_in_arena: bool = False,  # static: the prefix blocks ALREADY hold
+    #   this KV (radix-hit admission) — skip re-scattering them; see below
 ):
     """Prefill ``slot`` with up to Bs new requests while the rest of the
     pipeline state is parked. Returns the updated state.
@@ -594,6 +596,21 @@ def serve_admit(
     are SEEDED with the shared prefix's keys/values — ``prompts`` carries
     only each request's suffix, at absolute positions ``prefix_len + i``,
     and the prefix's prefill compute is never repeated (prefix caching).
+
+    ``prefix_in_arena`` (static, paged + prefix only) marks a RADIX-HIT
+    admission whose prefix operand was gathered straight from the arena
+    (``gather_prefix_kv``): the mapped shared blocks already hold the
+    prefix bytes, so the scatter back covers only the suffix/budget region
+    past them. For a bf16 arena the skipped writes were identical bytes (a
+    pure write saving); for a QUANTIZED arena they were NOT — the operand
+    dequantizes codes into the compute dtype, and requantizing that
+    rounded window re-snaps each shared block's scale and can drift its
+    codes by ±1 ulp, so every radix hit used to rewrite slightly different
+    bytes under concurrent readers of the same blocks. Skipping makes the
+    insert-time quantization the one-time scale snap it was meant to be:
+    shared block bytes are byte-stable across any number of hits. An
+    explicit ``PrefixHandle`` admission must NOT set this — its freshly
+    allocated blocks are first WRITTEN by the admission that maps them.
 
     Key-chain note (``key_override``): a row resuming a MIGRATED sampled
     request carries the chain its source replica would hold after the
@@ -693,23 +710,34 @@ def serve_admit(
         # a prefix handle) drives every length-indexed bookkeeping field
         total = pfx + prompt_len
         off0 = 0 if prefix_kv is None else int(prefix_kv[0].shape[3])
+        # radix-hit admissions skip the prefix-region scatter (the mapped
+        # shared blocks already hold these bytes — see the docstring); the
+        # match is block-aligned by construction, asserted at trace time
+        npfx = 0
+        if prefix_in_arena and block_size and off0:
+            assert off0 % block_size == 0, (
+                f"prefix_in_arena needs a block-aligned prefix, got "
+                f"{off0} tokens at block size {block_size}"
+            )
+            npfx = off0 // block_size
+        w0 = npfx * block_size
         scale_upd = {}
         if block_size and quantized:
             # insert-quantization: the slot's full-precision window (the
             # prefill just computed it) scatters as codes + fresh
             # per-block scales — quantized KV never exists as bf16 in HBM
-            tbl = _slot_tables(st, row0, Bs)
+            tbl = _slot_tables(st, row0, Bs)[:, npfx:]
             k_new, ks_new = _scatter_pages_q(
-                st.k, st.k_scale, tbl, cache.k, block_size
+                st.k, st.k_scale, tbl, cache.k[:, :, w0:], block_size
             )
             v_new, vs_new = _scatter_pages_q(
-                st.v, st.v_scale, tbl, cache.v, block_size
+                st.v, st.v_scale, tbl, cache.v[:, :, w0:], block_size
             )
             scale_upd = {"k_scale": ks_new, "v_scale": vs_new}
         elif block_size:
-            tbl = _slot_tables(st, row0, Bs)
-            k_new = _scatter_pages(st.k, tbl, cache.k, block_size)
-            v_new = _scatter_pages(st.v, tbl, cache.v, block_size)
+            tbl = _slot_tables(st, row0, Bs)[:, npfx:]
+            k_new = _scatter_pages(st.k, tbl, cache.k[:, :, w0:], block_size)
+            v_new = _scatter_pages(st.v, tbl, cache.v[:, :, w0:], block_size)
         else:
             k_new = jax.lax.dynamic_update_slice_in_dim(
                 st.k, cache.k, row0, axis=1

@@ -112,3 +112,15 @@ def save_profile_artifacts(
     with open(os.path.join(out_dir, "profile.json"), "w") as f:
         json.dump(payload, f, indent=2)
     return payload
+
+
+def load_profile(path: str) -> dict:
+    """Read a saved ``profile.json`` back (accepts the file itself or the
+    directory it was written into) — the read half of
+    ``save_profile_artifacts``. Delegates to the planner-side
+    implementation so the file convention lives in exactly one place
+    (``runtime/placement`` owns it: the planner must load without
+    importing this jax-backed package)."""
+    from ..runtime.placement import read_profile_json
+
+    return read_profile_json(path)

@@ -105,9 +105,20 @@ class Autoscaler:
         """(queued + in-flight + ingress backlog) / live slot capacity.
         >= 1.0 means every live slot is busy AND work is waiting; the
         signal keeps growing with backlog (it is not clamped), so a flood
-        reads as e.g. 3.0, not a saturated 1.0."""
+        reads as e.g. 3.0, not a saturated 1.0.
+
+        A disaggregated router (``runtime/disagg.DisaggServer``) exposes
+        ``role_load``, and the controller defers to it: the signal becomes
+        the WORST role pool's normalized load, so a saturated prefill tier
+        triggers scale-up even while the decode tier idles (the skew a
+        global average hides)."""
         if self._load_fn is not None:
             return float(self._load_fn())
+        role_load = getattr(self.target, "role_load", None)
+        if role_load is not None:
+            return float(role_load(
+                extra=int(self._extra_load()) if self._extra_load else 0
+            ))
         busy = slots = 0
         for s in list(self.target.servers):
             if getattr(s, "_closed", False):

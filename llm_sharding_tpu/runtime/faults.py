@@ -61,6 +61,11 @@ SITES = (
     #                    stalling/vanishing mid-stream; the ingress must
     #                    cancel the row and free its KV blocks exactly
     #                    like a real BrokenPipeError
+    "kv_handoff",      # one prefill→decode KV hand-off attempt (keyed by
+    #                    request id) — transient defers the hand-off to the
+    #                    next sweep (retried), permanent falls back to
+    #                    decoding where the request already lives; token
+    #                    identity must hold on every path
 )
 
 

@@ -319,6 +319,12 @@ class IngressServer:
     # ------------------------------------------------------------ pump loop
 
     def _backend_queued(self) -> int:
+        # a disaggregated router counts only its PREFILL-capable replicas'
+        # queues (fresh dispatches land there; the decode side's transient
+        # adoption queues would over-throttle the front door)
+        depth = getattr(self.backend, "prefill_queue_depth", None)
+        if depth is not None:
+            return int(depth())
         servers = getattr(self.backend, "servers", None)
         if servers is not None:
             return sum(len(s._queue) for s in servers)

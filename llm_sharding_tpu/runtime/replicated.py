@@ -509,13 +509,7 @@ class ReplicatedServer:
                      if h.per_server.get(src) is st.prefix),
                     None,
                 )
-            targets = sorted(
-                (t for t in self.servers
-                 if not t._closed
-                 and (st.prefix is None
-                      or (rh is not None and t in rh.per_server))),
-                key=self._load,
-            )
+            targets = self._migration_targets(st, rh)
             adopted = False
             last_err: Optional[BaseException] = cause
             for t in targets:
@@ -546,6 +540,20 @@ class ReplicatedServer:
                 REQUESTS_MIGRATED.labels(outcome="failed").inc()
                 failed += 1
         return moved, failed
+
+    def _migration_targets(self, st, rh) -> list:
+        """Candidate adopters for one extracted request, best first:
+        live, prefix-covered (when the request is handle-bound),
+        least-loaded. A hook — the disaggregated router overrides the
+        ORDERING (role-affine placement) but never the candidate set, so
+        correctness (any live replica can adopt) is inherited."""
+        return sorted(
+            (t for t in self.servers
+             if not t._closed
+             and (st.prefix is None
+                  or (rh is not None and t in rh.per_server))),
+            key=self._load,
+        )
 
     # --------------------------------------------------------- elasticity
 

@@ -692,6 +692,11 @@ class Request:
         #           admission (runtime/fairness.py) — but it rides the
         #           request through migration/snapshot so traces and logs
         #           stay attributable
+        "staged_radix",  # a RadixRef taken ONE STEP AHEAD of admission
+        #           (``_stage_radix_plan``): the host-tier restore it may
+        #           trigger dispatches behind the in-flight decode chunk
+        #           instead of serializing with the admission — released
+        #           on every path that removes the request from the queue
         "__weakref__",  # the dp router tracks request→replica ownership
     )
 
@@ -732,6 +737,7 @@ class Request:
         self.baked = 0
         self.carried_rng: Optional[np.ndarray] = None
         self.tenant = tenant
+        self.staged_radix = None
         self.submitted_at = time.perf_counter()
         self.deadline_at = (
             None if deadline_s is None else self.submitted_at + deadline_s
@@ -1946,6 +1952,13 @@ class PipelineServer:
                 if self._trace:
                     self._trace.emit("apply", dur_s=dt_apply, applied=applied)
                 _update_load_gauges()
+            if self._radix is not None and self._queue:
+                # stage the NEXT admission's radix plan now, AFTER this
+                # step's decode dispatch: a host-tier restore it triggers
+                # rides the device queue behind the in-flight chunk and
+                # overlaps its compute, instead of serializing restore →
+                # admit inside the next step's admission phase
+                self._stage_radix_plan()
             snap_due = self._capture_autosnapshot()
             if (
                 self._health == DEGRADED
@@ -2159,6 +2172,7 @@ class PipelineServer:
                     return False
                 req.done = True
                 req.finished_at = time.perf_counter()
+                self._release_staged(req)
                 self.counters.inc("requests_cancelled")
                 _update_load_gauges()
                 return True
@@ -2566,6 +2580,33 @@ class PipelineServer:
             return None
         return ref
 
+    def _stage_radix_plan(self) -> None:
+        """Take the queue head's radix plan ONE STEP AHEAD of its admission
+        (PR-8 leftover, ROADMAP item 1): ``take()`` streams any host-tier
+        node on the match path back to device, and staging it here — right
+        after the step's decode chunk dispatched — lets that host→device
+        copy execute behind the in-flight chunk instead of stalling the
+        admission that consumes it. The ref is pinned, so eviction/splits
+        cannot touch the path while the request waits; every queue-removal
+        path releases it (``_release_staged``)."""
+        head = self._queue[0]
+        if (
+            head.staged_radix is not None or head.prefix is not None
+            or head.embeds is not None
+        ):
+            return
+        plan = self._radix_plan(head)
+        if plan is not None:
+            head.staged_radix = plan
+
+    def _release_staged(self, req: "Request") -> None:
+        """Drop a queued request's staged radix ref (cancel, failure,
+        shutdown, extraction — any exit that is not the admission that
+        would consume it)."""
+        if req.staged_radix is not None and self._radix is not None:
+            self._radix.release(req.staged_radix)
+        req.staged_radix = None
+
     def release_prefix(self, handle: "PrefixHandle") -> None:
         """Drop a paged ``prefill_prefix`` handle's own block references.
         Rows already mapping the blocks keep them alive (refcounts); the
@@ -2619,6 +2660,7 @@ class PipelineServer:
                     raise ValueError(
                         f"request {req.id} is not held by this server"
                     ) from None
+                self._release_staged(req)
             else:
                 if self._rows[req.row] is not req:
                     raise ValueError(
@@ -2837,6 +2879,7 @@ class PipelineServer:
         req.error = err
         req.done = True
         req.finished_at = time.perf_counter()
+        self._release_staged(req)
         if req.row is not None and self._rows[req.row] is req:
             self._rows[req.row] = None
             self._release_row_blocks(req.row)
@@ -3138,8 +3181,14 @@ class PipelineServer:
             # prefills, at absolute positions n + i — with the matched
             # blocks mapped read-only into the row's table. req.prompt
             # stays the FULL prompt (migration/spec-drafting/snapshot all
-            # read it), the split below is admission-local.
-            rplan = self._radix_plan(head)
+            # read it), the split below is admission-local. A plan staged
+            # one step ahead (``_stage_radix_plan``) is consumed here —
+            # its host-tier restore already overlapped the previous
+            # chunk's compute; pinning froze the path, so it stays valid.
+            rplan = head.staged_radix
+            head.staged_radix = None
+            if rplan is None:
+                rplan = self._radix_plan(head)
             spx_n = 0 if rplan is None else rplan.n
             # Co-admit only same-bucket requests: submit() validated each
             # request's capacity needs against ITS OWN bucket, and admission
@@ -3342,11 +3391,17 @@ class PipelineServer:
                     pn, spx_key = spx_n, spx_n
                 else:
                     pkv, pn, spx_key = None, None, None
+                # radix-hit admissions skip re-scattering the shared
+                # prefix blocks (their bytes are already in the arena —
+                # for quantized arenas the skip is what keeps shared
+                # block codes+scales byte-stable across hits)
+                in_arena = rplan is not None
                 record_shape_key(
                     "serve_admit",
                     (self.num_stages, Bs, self.capacity, bucket, is_emb,
                      spx_key, self._filtering,
-                     self.tp, self.kv_block_size, carried, self.kv_dtype),
+                     self.tp, self.kv_block_size, carried, self.kv_dtype,
+                     in_arena),
                 )
                 self.state, tok0 = serve_ops.serve_admit(
                     self.cfg,
@@ -3380,6 +3435,7 @@ class PipelineServer:
                     ),
                     tp=self.tp,
                     block_size=self.kv_block_size or 0,
+                    prefix_in_arena=in_arena,
                 )
                 # the admission-sampled first token is applied like a chunk
                 # log — deferred, so its fetch also overlaps device compute

@@ -283,6 +283,60 @@ def test_serve_prefix_cache_flag_fast_fails(shards, capsys):
     assert "--host-pool-blocks" in err and "host" in err
 
 
+def test_serve_disagg_flags_fast_fail(shards, capsys, tmp_path):
+    """--disagg flag combinations fail in milliseconds, before model load
+    (same pre-load pattern as the kv flag pairing): missing dp, missing
+    paged/prefix-cache prerequisites, role flags without --disagg, a bad
+    --roles list, and a malformed --profile-json."""
+    rc = cli.main(["serve", shards, "--disagg"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "--disagg" in err and "--data-parallel" in err
+    rc = cli.main([
+        "serve", shards, "--disagg", "--data-parallel", "2",
+    ])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "--kv-block-size" in err
+    rc = cli.main([
+        "serve", shards, "--disagg", "--data-parallel", "2",
+        "--kv-block-size", "16", "--kv-blocks", "40",
+    ])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "--prefix-cache" in err
+    rc = cli.main(["serve", shards, "--prefill-replicas", "1"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "--disagg" in err
+    rc = cli.main([
+        "serve", shards, "--disagg", "--data-parallel", "2",
+        "--kv-block-size", "16", "--kv-blocks", "40",
+        "--prefix-cache", "hbm", "--prefill-replicas", "2",
+    ])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "--prefill-replicas" in err and "[1, 1]" in err
+    rc = cli.main([
+        "serve", shards, "--disagg", "--data-parallel", "2",
+        "--kv-block-size", "16", "--kv-blocks", "40",
+        "--prefix-cache", "hbm", "--roles", "prefill,bogus",
+    ])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "--roles" in err
+    bad = tmp_path / "profile.json"
+    bad.write_text("{}")
+    rc = cli.main([
+        "serve", shards, "--disagg", "--data-parallel", "2",
+        "--kv-block-size", "16", "--kv-blocks", "40",
+        "--prefix-cache", "hbm", "--profile-json", str(bad),
+    ])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "--profile-json" in err
+
+
 def test_serve_speculate_cli(shards, capsys, monkeypatch):
     """--speculate K drives the speculative serve loop end to end from the
     CLI (stdin prompt → streamed completion), and the banner still prints."""
@@ -638,6 +692,42 @@ def test_serve_command_dp_drain_spawn(shards, capsys, monkeypatch):
     assert "drain failed: no live replica 9" in err
     assert "unknown control line ':bogus'" in err
     assert '"requests_completed": 3' in err
+
+
+def test_serve_command_disagg_daemon(shards, capsys, monkeypatch):
+    """--disagg daemon end to end from the CLI: prompts prefill on the
+    prefill replica, hand off, and stream back — banner names the roles."""
+    from llm_sharding_tpu.obs.metrics import DISAGG_HANDOFFS
+    from llm_sharding_tpu.runtime import engine as engine_mod
+
+    monkeypatch.setattr(
+        engine_mod.PipelineEngine,
+        "_require_tokenizer",
+        lambda self: IdTokenizer(),
+    )
+    monkeypatch.setattr("sys.stdin", io.StringIO("hi there\nsecond one\n"))
+    moved0 = (
+        DISAGG_HANDOFFS.labels(outcome="ok").value
+        + DISAGG_HANDOFFS.labels(outcome="cold").value
+    )
+    rc = cli.main(
+        [
+            "serve", shards, "--max-new", "4", "--stages", "2",
+            "--data-parallel", "2", "--capacity", "64", "--dtype", "f32",
+            "--disagg", "--kv-block-size", "8", "--kv-blocks", "40",
+            "--prefix-cache", "hbm",
+        ]
+    )
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert len([l for l in captured.out.splitlines() if l.strip()]) == 2
+    assert "disagg roles: prefill,decode" in captured.err
+    assert '"requests_completed": 2' in captured.err
+    moved = (
+        DISAGG_HANDOFFS.labels(outcome="ok").value
+        + DISAGG_HANDOFFS.labels(outcome="cold").value
+    ) - moved0
+    assert moved == 2
 
 
 # ------------------------------------------------- production ingress flags

@@ -442,3 +442,45 @@ def test_snapshot_restore_int8_continues_identically(setup):
     got = [list(r.tokens) for r in revived]
     assert got == full
     srv2.close()
+
+
+def test_radix_hit_shared_blocks_byte_stable(setup):
+    """ISSUE-12 satellite (PR-9 leftover c): a quantized radix-hit
+    admission SKIPS re-scattering the already-quantized shared prefix
+    blocks. The old path re-quantized the dequantized (compute-dtype-
+    rounded) prefix window, re-snapping each shared block's scale and
+    drifting codes by ±1 ulp under concurrent readers; with the skip, the
+    insert-time quantization is a one-time scale snap — the shared
+    blocks' codes AND scales are byte-identical before and after any
+    number of hits."""
+    params, eng = setup
+    srv = _serve(eng, kv_dtype="int8", prefix_cache="hbm")
+    p = np.random.default_rng(90).integers(
+        1, CFG.vocab_size, 2 * BS + 3
+    ).astype(np.int32)
+    r1 = srv.submit(p, 6)
+    srv.run_until_idle()
+    assert r1.error is None
+    aligned = (len(p) // BS) * BS
+    with srv._mutex:
+        ref = srv._radix.take(p, aligned)
+        assert ref is not None and ref.n == aligned
+        blocks = list(ref.blocks)
+        before = [
+            np.asarray(a).copy() for a in srv._read_arena_blocks(blocks)
+        ]
+        srv._radix.release(ref)
+    assert len(before) == 4  # codes + scales for K and V
+    ext = np.random.default_rng(91).integers(
+        1, CFG.vocab_size, 3
+    ).astype(np.int32)
+    r2 = srv.submit(np.concatenate([p, ext]), 6)
+    srv.run_until_idle()
+    assert r2.error is None
+    assert srv._radix.hit_tokens >= aligned  # the hit really happened
+    after = srv._read_arena_blocks(blocks)
+    for i, (b, a) in enumerate(zip(before, after)):
+        assert np.array_equal(b, np.asarray(a)), (
+            f"shared-block component {i} drifted across a radix hit"
+        )
+    srv.close()

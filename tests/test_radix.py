@@ -656,3 +656,85 @@ def test_metrics_hit_rate_host_tier_and_waste(setup):
     _update_load_gauges()
     assert KV_HOST_TIER_BLOCKS.value >= srv._radix.host_blocks > 0
     srv.close()
+
+
+# --------------------------------------- staged host-tier restore overlap
+
+
+def test_host_restore_dispatches_one_step_before_admission(setup):
+    """ISSUE-12 satellite (PR-8 leftover): the host→device restore of a
+    matched demoted prefix is dispatched ONE STEP AHEAD of the admission
+    that consumes it (``_stage_radix_plan``), so it overlaps the in-flight
+    decode chunk instead of serializing restore → admit inside one step.
+    The spy records the step each event lands on: the restore must strictly
+    precede the admission."""
+    params, eng = setup
+    srv = radix_serve(eng, cache="host")
+    pa = prompt(90, 2 * BS)
+    w = srv.submit(pa, 4)
+    srv.run_until_idle()
+    assert w.error is None
+    with srv._mutex:
+        srv._radix.demote_all()
+    assert srv._radix.host_blocks > 0
+    # fill every slot with live decodes so the warm request has to QUEUE
+    # (staging only matters for a request that waits at least one step)
+    blockers = [
+        srv.submit(prompt(91 + i, 4), 6 if i == 0 else 30) for i in range(4)
+    ]
+    srv.step()  # admits all four blockers; no free slot remains
+    assert all(b.row is not None for b in blockers)
+
+    steps = 0
+    restore_steps = []
+    orig = srv._radix.write_kv
+
+    def spy(blocks, *kv):
+        restore_steps.append(steps)
+        return orig(blocks, *kv)
+
+    srv._radix.write_kv = spy
+    warm = np.concatenate([pa, prompt(95, 3)])
+    rw = srv.submit(warm, 4)
+    admit_step = None
+    while not rw.done:
+        steps += 1
+        srv.step()
+        if admit_step is None and rw.row is not None:
+            admit_step = steps
+    assert restore_steps, "the host-tier restore never ran"
+    assert admit_step is not None
+    # the restore dispatched on an EARLIER step than the admission — it no
+    # longer serializes with the productive step that admits the match
+    assert restore_steps[0] < admit_step, (restore_steps, admit_step)
+    assert len(restore_steps) == 1  # staged once, not per waiting step
+    assert srv._radix.host_hit_tokens >= 2 * BS
+    assert list(rw.tokens) == oracle(params, warm, 4)
+    srv.run_until_idle()
+    for b in blockers:
+        assert b.error is None
+    check_clean(srv)
+    srv.close()
+
+
+def test_staged_plan_released_on_queued_cancel(setup):
+    """A queued request whose radix plan was staged releases its pins on
+    cancel — the tree must stay evictable (refs drain to zero)."""
+    params, eng = setup
+    srv = radix_serve(eng)
+    pa = prompt(96, 2 * BS)
+    w = srv.submit(pa, 4)
+    srv.run_until_idle()
+    blockers = [srv.submit(prompt(97 + i, 4), 30) for i in range(4)]
+    srv.step()
+    rw = srv.submit(np.concatenate([pa, prompt(99, 3)]), 4)
+    srv.step()  # stages rw's plan (pins the matched path)
+    assert rw.staged_radix is not None
+    assert srv.cancel(rw)
+    assert rw.staged_radix is None
+    with srv._mutex:
+        assert all(n.refs == 0 for n in srv._radix._iter_nodes()
+                   if n not in ())
+    srv.run_until_idle()
+    check_clean(srv)
+    srv.close()
