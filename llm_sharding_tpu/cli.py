@@ -776,6 +776,10 @@ def cmd_serve(args) -> int:
             tenants=getattr(args, "tenants_config", None),
             max_queue=args.max_queue or None,
             model_name=eng.cfg.model_type,
+            # the trace ROOT spans (ingress + fair-queue wait) land in
+            # PATH.ingress; trace-report merges them with the per-replica
+            # files into one tree per request
+            trace_path=args.trace_path,
             on_error=lambda msg: print(msg, file=sys.stderr),
         )
         if ingress is not None:
@@ -795,6 +799,9 @@ def cmd_serve(args) -> int:
             up_after_s=getattr(args, "autoscale_up_after", 1.0),
             down_after_s=getattr(args, "autoscale_down_after", 5.0),
             cooldown_s=getattr(args, "autoscale_cooldown", 3.0),
+            # paced role rebalance: only a --disagg router with a
+            # --profile-json planner acts on it (a no-op otherwise)
+            rebalance_every_s=getattr(args, "rebalance_every", 30.0),
             extra_load=(
                 (lambda: ingress.fair.depth()) if ingress is not None
                 else None
@@ -1211,6 +1218,44 @@ def cmd_profile(args) -> int:
     return 0
 
 
+def cmd_trace_report(args) -> int:
+    """Merge per-replica/ingress/router JSONL trace files, rebuild the
+    cross-replica span trees, and print per-phase latency attribution
+    (see obs/report.py). Runs jax-free — point it at the files wherever
+    they landed."""
+    import glob as _glob
+
+    from .obs.report import (
+        load_events, render_report, report_json, trace_json,
+    )
+
+    paths = []
+    for pat in args.files:
+        hits = sorted(_glob.glob(pat)) if any(
+            c in pat for c in "*?[") else [pat]
+        paths.extend(hits)
+    if not paths:
+        print("no trace files matched", file=sys.stderr)
+        return 2
+    try:
+        events = load_events(paths)
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not events:
+        print("no span events in the input files", file=sys.stderr)
+        return 1
+    if args.json and args.trace is not None:
+        out = trace_json(events, args.trace)
+        print(json.dumps(out, sort_keys=True))
+        return 0 if out["found"] else 1
+    if args.json:
+        print(json.dumps(report_json(events, top=args.top), sort_keys=True))
+    else:
+        print(render_report(events, top=args.top, trace_id=args.trace))
+    return 0
+
+
 def cmd_bench(args) -> int:
     import importlib.util
     import os
@@ -1474,9 +1519,20 @@ def build_parser() -> argparse.ArgumentParser:
     )
     s.add_argument(
         "--trace-path", default=None, dest="trace_path",
-        help="append one JSONL line per span (admit/chunk/apply/request) to "
-        "this file for offline latency analysis; with --data-parallel each "
-        "replica writes PATH.r<i>",
+        help="append one JSONL line per span to this file for offline "
+        "analysis (rotated at 64 MiB to PATH.1). Every span carries a "
+        "trace_id, so 'trace-report PATH*' rebuilds per-request trees "
+        "across files; with --data-parallel each replica writes PATH.r<i> "
+        "plus PATH.router for hand-off/failover decisions, and --http-port "
+        "adds PATH.ingress for the HTTP root spans",
+    )
+    s.add_argument(
+        "--rebalance-every", type=float, default=30.0,
+        dest="rebalance_every",
+        help="with --autoscale --disagg --profile-json: seconds between "
+        "paced prefill:decode role-rebalance attempts "
+        "(DisaggServer.rebalance — one role flip max per tick, riding the "
+        "drain/spawn path; 0 = operator-only)",
     )
     s.add_argument(
         "--disagg", action="store_true",
@@ -1595,6 +1651,31 @@ def build_parser() -> argparse.ArgumentParser:
 
     b = sub.add_parser("bench", help="repo benchmark (one JSON line)")
     b.set_defaults(fn=cmd_bench)
+
+    tr = sub.add_parser(
+        "trace-report",
+        help="merge JSONL trace files, rebuild span trees, attribute "
+        "latency per phase/tenant",
+    )
+    tr.add_argument(
+        "files", nargs="+",
+        help="trace files (globs ok): PATH, PATH.r<i>, PATH.router, "
+        "PATH.ingress, PATH*.1 rollovers — any subset; spans join by "
+        "trace_id",
+    )
+    tr.add_argument(
+        "--top", type=int, default=5,
+        help="how many slowest traces to list (default 5)",
+    )
+    tr.add_argument(
+        "--trace", default=None,
+        help="print one trace's full span tree instead of the summary",
+    )
+    tr.add_argument(
+        "--json", action="store_true",
+        help="machine-readable report (one JSON object)",
+    )
+    tr.set_defaults(fn=cmd_trace_report)
     return p
 
 
@@ -1615,6 +1696,10 @@ def main(argv=None) -> int:
     # initializes the backend in-process anyway, so the authoritative
     # jax.devices() probe is safe; `worker` must not touch the backend
     # before jax.distributed.initialize, so it falls back to the env var.
+    if args.command == "trace-report":
+        # pure file analysis — no backend, no compile cache, runs on hosts
+        # with no accelerator stack at all
+        return args.fn(args)
     if args.command == "worker":
         on_cpu = (
             os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()

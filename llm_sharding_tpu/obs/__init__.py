@@ -5,13 +5,18 @@ Three modules, all stdlib-only (importable before jax backend init):
 - ``metrics`` — thread-safe labeled counters/gauges/histograms with quantile
   readout, Prometheus text + JSON snapshot, and the process-wide
   ``REGISTRY`` every subsystem records into;
-- ``trace``   — JSONL span writer (one line per admit/chunk/apply/request
-  span) behind the server's ``trace_path=`` knob;
+- ``trace``   — request-centric tracing: ``TraceContext`` propagation,
+  the rotating JSONL span writer behind the server's ``trace_path=`` knob,
+  and the in-memory ``FLIGHT_RECORDER`` span ring;
 - ``http``    — ``MetricsServer``: a background stdlib-``http.server``
-  thread serving ``/metrics`` (Prometheus), ``/statz`` (JSON) and
-  ``/healthz``, wired into the CLI via ``--metrics-port``.
+  thread serving ``/metrics`` (Prometheus, with slow-request exemplars),
+  ``/statz`` (JSON), ``/debugz`` (the flight-recorder postmortem bundle)
+  and ``/healthz``, wired into the CLI via ``--metrics-port``;
+- ``report``  — the ``trace-report`` CLI's span-tree reconstruction and
+  per-phase latency attribution over merged per-replica JSONL files.
 
-Metric names are documented in README.md § Observability.
+Metric names and the span schema are documented in README.md
+(§ Observability, § Tracing & postmortems).
 """
 
 from .metrics import (  # noqa: F401
@@ -22,5 +27,11 @@ from .metrics import (  # noqa: F401
     StateGauge,
     record_shape_key,
 )
-from .trace import TraceWriter  # noqa: F401
+from .trace import (  # noqa: F401
+    FLIGHT_RECORDER,
+    SpanRing,
+    TraceContext,
+    TraceWriter,
+    emit_span,
+)
 from .http import MetricsServer  # noqa: F401

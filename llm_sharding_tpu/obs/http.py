@@ -2,10 +2,20 @@
 
 ``MetricsServer`` serves the process-wide registry on:
 
-- ``/metrics`` — Prometheus text format 0.0.4 (scrape target);
+- ``/metrics`` — Prometheus text format 0.0.4 (scrape target); a scraper
+  negotiating ``Accept: application/openmetrics-text`` gets the
+  OpenMetrics flavor with slow-request trace-id exemplars on the latency
+  histograms (exemplars are not legal 0.0.4 syntax, so the default stays
+  strictly-parseable plain text);
 - ``/statz``   — JSON: the registry snapshot (histograms with p50/p90/p99)
   plus any extra named providers (the serve daemon registers its live
   ``Counters.snapshot`` so ``/statz`` carries the exact per-server tally);
+- ``/debugz``  — the flight-recorder postmortem bundle: recent spans from
+  the process-wide in-memory ring (``obs.trace.FLIGHT_RECORDER`` — present
+  even when no ``trace_path`` was configured), the metrics snapshot
+  (including slow-request exemplars), every ``/statz`` provider (live
+  counters, per-replica stats with KV/radix occupancy) and the health
+  state, as one JSON object. The first thing to curl after a 504;
 - ``/healthz`` — health probe. Without a ``health_provider`` it is a bare
   liveness check (200 ``ok``); with one (the serve CLI attaches the live
   server's health state machine) it returns 200 ``ok`` only while the
@@ -23,10 +33,12 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
 from .metrics import REGISTRY, Registry
+from .trace import FLIGHT_RECORDER
 
 
 def write_ignoring_disconnect(wfile, data: bytes, flush: bool = False) -> bool:
@@ -124,6 +136,26 @@ class MetricsServer:
                 payload[name] = {"error": str(e)[:200]}
         return payload
 
+    def _debugz_payload(self) -> dict:
+        """One self-contained postmortem bundle. Health reads through the
+        same provider-failure policy as ``/healthz`` (an unreadable state is
+        reported, not raised), and every ``/statz`` provider rides along —
+        the bundle must be maximally informative precisely when parts of
+        the daemon are broken."""
+        health = None
+        if self._health is not None:
+            try:
+                health = str(self._health())
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                health = f"unreadable: {e}"[:200]
+        bundle = self._statz_payload()
+        bundle.update(
+            generated_at=time.time(),
+            health=health,
+            recent_spans=FLIGHT_RECORDER.snapshot(),
+        )
+        return bundle
+
     def _handler_class(self):
         server = self
 
@@ -132,18 +164,39 @@ class MetricsServer:
                 path = self.path.split("?", 1)[0].rstrip("/") or "/"
                 code = 200
                 if path == "/metrics":
-                    body = server.registry.prometheus_text().encode()
-                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    # content negotiation: exemplars are only legal in the
+                    # OpenMetrics flavor, so a scraper that asks for it
+                    # (modern Prometheus sends this Accept when exemplar
+                    # storage is on) gets them; everyone else gets pure
+                    # text format 0.0.4, which a strict parser accepts
+                    om = "application/openmetrics-text" in (
+                        self.headers.get("Accept") or ""
+                    )
+                    body = server.registry.prometheus_text(
+                        openmetrics=om
+                    ).encode()
+                    ctype = (
+                        "application/openmetrics-text; version=1.0.0; "
+                        "charset=utf-8"
+                        if om else "text/plain; version=0.0.4; charset=utf-8"
+                    )
                 elif path == "/statz":
                     body = json.dumps(
                         server._statz_payload(), sort_keys=True
+                    ).encode()
+                    ctype = "application/json"
+                elif path == "/debugz":
+                    body = json.dumps(
+                        server._debugz_payload(), sort_keys=True
                     ).encode()
                     ctype = "application/json"
                 elif path == "/healthz":
                     code, body = server._health_response()
                     ctype = "text/plain; charset=utf-8"
                 else:
-                    self.send_error(404, "try /metrics, /statz or /healthz")
+                    self.send_error(
+                        404, "try /metrics, /statz, /debugz or /healthz"
+                    )
                     return
                 try:
                     self.send_response(code)

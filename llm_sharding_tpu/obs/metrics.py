@@ -23,6 +23,7 @@ import json
 import math
 import re
 import threading
+import time
 from typing import Callable, Dict, Optional, Sequence, Tuple
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -38,6 +39,12 @@ DEFAULT_RATE_BUCKETS = (
     1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0,
     1000.0, 2000.0, 5000.0, 10000.0,
 )
+
+#: How long a bucket's exemplar stays "fresh": within the TTL only a larger
+#: observation replaces it (bucket-max semantics — the slowest recent
+#: request wins); past it any new observation does (recency semantics — a
+#: p99 spike from an hour ago must not shadow today's).
+EXEMPLAR_TTL_S = 60.0
 
 
 def _fmt(v: float) -> str:
@@ -55,6 +62,16 @@ def _fmt(v: float) -> str:
 
 def _escape_label(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _exemplar_str(ex) -> str:
+    """OpenMetrics exemplar suffix for one bucket line ('' when absent)."""
+    if ex is None:
+        return ""
+    tid, v, ts = ex
+    return (
+        f' # {{trace_id="{_escape_label(tid)}"}} {_fmt(v)} {repr(float(ts))}'
+    )
 
 
 def _label_str(names: Tuple[str, ...], values: Tuple[str, ...]) -> str:
@@ -110,7 +127,7 @@ class _GaugeChild:
 
 
 class _HistogramChild:
-    __slots__ = ("_lock", "bounds", "counts", "sum", "count")
+    __slots__ = ("_lock", "bounds", "counts", "sum", "count", "exemplars")
 
     def __init__(self, lock: threading.Lock, bounds: Tuple[float, ...]):
         self._lock = lock
@@ -118,8 +135,12 @@ class _HistogramChild:
         self.counts = [0] * (len(bounds) + 1)  # +1: the +Inf bucket
         self.sum = 0.0
         self.count = 0
+        # per-bucket slow-request exemplar: index -> (trace_id, value, ts).
+        # Sparse (most buckets never see a traced observation); see
+        # EXEMPLAR_TTL_S for the replacement policy.
+        self.exemplars: Dict[int, Tuple[str, float, float]] = {}
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, trace_id: Optional[str] = None) -> None:
         v = float(v)
         with self._lock:
             i = 0
@@ -131,6 +152,14 @@ class _HistogramChild:
             self.counts[i] += 1
             self.sum += v
             self.count += 1
+            if trace_id is not None:
+                cur = self.exemplars.get(i)
+                now = time.time()
+                if (
+                    cur is None or v >= cur[1]
+                    or now - cur[2] > EXEMPLAR_TTL_S
+                ):
+                    self.exemplars[i] = (str(trace_id), v, now)
 
     def snap(self):
         """Atomic (counts, sum, count) copy — exposition must read under the
@@ -138,6 +167,10 @@ class _HistogramChild:
         count that disagrees with its own sum/buckets."""
         with self._lock:
             return list(self.counts), self.sum, self.count
+
+    def snap_exemplars(self) -> Dict[int, Tuple[str, float, float]]:
+        with self._lock:
+            return dict(self.exemplars)
 
     def quantile(self, q: float) -> Optional[float]:
         """Estimated q-quantile (0 < q <= 1) by linear interpolation within
@@ -232,8 +265,8 @@ class _Family:
     def dec(self, n: float = 1.0) -> None:
         self._solo().dec(n)
 
-    def observe(self, v: float) -> None:
-        self._solo().observe(v)
+    def observe(self, v: float, trace_id: Optional[str] = None) -> None:
+        self._solo().observe(v, trace_id=trace_id)
 
     @property
     def value(self) -> float:
@@ -348,33 +381,58 @@ class Registry:
 
     # ------------------------------------------------------------- readout
 
-    def prometheus_text(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+    def prometheus_text(self, openmetrics: bool = False) -> str:
+        """Text exposition. Default: pure Prometheus text format 0.0.4 —
+        NO exemplars, because 0.0.4 allows only an optional timestamp after
+        the sample value and a strict parser fails the whole scrape on
+        anything more. ``openmetrics=True`` emits the OpenMetrics flavor
+        instead (what a scraper negotiates via ``Accept:
+        application/openmetrics-text`` — the standard channel for
+        exemplars): slow-request exemplars ride the histogram bucket lines
+        (``… # {trace_id="…"} v ts``), counter metadata drops the
+        ``_total`` suffix as the spec requires, and the body terminates
+        with ``# EOF``."""
         out = []
         for name, fam in self._sorted_families():
+            meta_name = (
+                name[: -len("_total")]
+                if openmetrics and fam.kind == "counter"
+                and name.endswith("_total") else name
+            )
             if fam.help:
-                out.append(f"# HELP {name} {fam.help}")
-            out.append(f"# TYPE {name} {fam.kind}")
+                out.append(f"# HELP {meta_name} {fam.help}")
+            out.append(f"# TYPE {meta_name} {fam.kind}")
             for values, child in fam.series():
                 ls = _label_str(fam.label_names, values)
                 if fam.kind == "histogram":
                     counts, total_sum, _ = child.snap()
+                    exem = (
+                        child.snap_exemplars() if openmetrics else {}
+                    )
                     cum = 0
-                    for b, c in zip(fam.buckets, counts):
+                    for i, (b, c) in enumerate(zip(fam.buckets, counts)):
                         cum += c
                         le = _label_str(
                             fam.label_names + ("le",), values + (_fmt(b),)
                         )
-                        out.append(f"{name}_bucket{le} {cum}")
+                        out.append(
+                            f"{name}_bucket{le} {cum}"
+                            + _exemplar_str(exem.get(i))
+                        )
                     cum += counts[-1]
                     le = _label_str(
                         fam.label_names + ("le",), values + ("+Inf",)
                     )
-                    out.append(f"{name}_bucket{le} {cum}")
+                    out.append(
+                        f"{name}_bucket{le} {cum}"
+                        + _exemplar_str(exem.get(len(fam.buckets)))
+                    )
                     out.append(f"{name}_sum{ls} {_fmt(total_sum)}")
                     out.append(f"{name}_count{ls} {cum}")
                 else:
                     out.append(f"{name}{ls} {_fmt(child.value)}")
+        if openmetrics:
+            out.append("# EOF")
         return "\n".join(out) + "\n"
 
     def json_snapshot(self) -> dict:
@@ -402,6 +460,21 @@ class Registry:
                         p99=_quantile_from(fam.buckets, counts, total, 0.99),
                         buckets=buckets,
                     )
+                    exem = child.snap_exemplars()
+                    if exem:
+                        # keyed by bucket upper bound; a p99 spike on /statz
+                        # links straight to its trace_id
+                        entry["exemplars"] = {
+                            (
+                                _fmt(fam.buckets[i])
+                                if i < len(fam.buckets) else "+Inf"
+                            ): {
+                                "trace_id": tid,
+                                "value": v,
+                                "ts": ts,
+                            }
+                            for i, (tid, v, ts) in sorted(exem.items())
+                        }
                 else:
                     entry["value"] = child.value
                 series.append(entry)

@@ -1,62 +1,254 @@
-"""Structured JSONL trace events: one line per span, for offline analysis.
+"""Request-centric distributed tracing: span events, context propagation,
+the in-memory flight recorder, and the rotating JSONL writer.
 
-The serving loop's phase timings (admit / chunk dispatch / log apply) and
-per-request latency spans (queue-wait, TTFT, end-to-end) stream to a file as
-they happen — ``jq``/pandas-friendly, append-only, crash-safe at line
-granularity. Enabled per server via ``PipelineServer(..., trace_path=)`` /
-``cli serve --trace-path``.
+PR 1 gave each server a flat JSONL span stream; this module upgrades it to
+Dapper-style request tracing (Sigelman et al. 2010): a ``TraceContext``
+(``trace_id`` + span ids) is born at ingress (``X-Trace-Id`` honored) or at
+``submit()``, rides the ``Request`` through snapshots, ``extract``/``adopt``
+migration and the disaggregated hand-off, and every stage emits a CHILD span
+— so merging the per-replica JSONL files by ``trace_id`` reconstructs the
+full cross-replica tree (``python -m llm_sharding_tpu trace-report``).
 
-Schema (one JSON object per line):
+Schema (one JSON object per line / ring entry):
 
     {"ts": <unix seconds, float>,   # event END time
-     "span": "<name>",              # admit | chunk | apply | request
+     "span": "<name>",              # see the table in README § Tracing
      "dur_s": <float>,              # span duration (absent for point events)
+     "src": "<emitter>",            # s0 | r<d> | router | ingress
+     "trace_id": "<hex>",           # request attribution (absent on
+     "span_id": "<hex>",            #  process-level decision spans)
+     "parent": "<hex>",
      ...span-specific fields}
 
-Span fields:
+Span names: ``ingress`` (HTTP arrival→response; the tree root for HTTP
+traffic), ``queue`` (ingress fair-queue wait), ``request`` (backend
+submission→finish; the per-request root for backend children), ``radix``
+(prefix-cache match at admission), ``prefill``/``admit`` (admission
+dispatch), ``chunk``/``apply`` (step phases, uncorrelated), ``decode``
+(bucketed committed-token runs), ``extract``/``adopt``/``migrate``
+(live migration), ``handoff`` (disagg KV stream), and the decision spans
+``failover``/``drain``/``spawn``/``rebalance``/``autoscale``.
 
-- ``admit``:   slot, ids, bucket, chunked, n (batch size)
-- ``chunk``:   m0 (first microstep), cycles — dur_s is HOST dispatch time
-               (the device executes asynchronously)
-- ``apply``:   applied (log entries drained) — dur_s includes the blocking
-               device fetch when the pipeline depth is exceeded
-- ``request``: id, tokens, queue_wait_s, ttft_s, tok_s — emitted at
-               completion; dur_s is submission→finish
+Every span ALSO lands in the process-wide ``FLIGHT_RECORDER`` — a bounded
+ring of recent spans served by ``/debugz`` (obs/http.py) — so a postmortem
+bundle exists even when no ``trace_path`` was configured. Ring recording is
+cheap (one dict + deque append under a lock; bench gates it <2% of serve
+throughput) and can be disabled for A/B measurement via
+``FLIGHT_RECORDER.set_enabled(False)``.
 
 Writes are line-buffered and serialized per writer; a full line lands per
 ``write`` call, so concurrent writers appending to one file (the dp daemon
 writes one file per replica instead, see runtime/replicated.py) do not
-interleave mid-line on POSIX appends.
+interleave mid-line on POSIX appends. ``TraceWriter`` rotates at
+``max_bytes`` (current file renamed to ``<path>.1``, replacing any previous
+rollover) so a long-lived daemon cannot fill the disk.
 """
 
 from __future__ import annotations
 
+import collections
 import json
+import os
+import re
 import threading
 import time
 from typing import Optional
 
+#: Rollover threshold for ``TraceWriter`` (bytes). At ~150 B/span this keeps
+#: roughly the last 400k spans on disk (current file + one rollover).
+DEFAULT_TRACE_MAX_BYTES = 64 * 1024 * 1024
+
+_ID_RE = re.compile(r"^[A-Za-z0-9_.\-]{1,128}$")
+
+
+def _gen_id() -> str:
+    """16 hex chars of OS randomness — cheap (~1 µs), collision-safe at any
+    realistic request rate, and stable across processes (no counter to
+    collide when replicas generate ids independently)."""
+    return os.urandom(8).hex()
+
+
+def valid_trace_id(tid) -> bool:
+    """Whether a caller-supplied id (the ``X-Trace-Id`` header) is safe to
+    honor: short, printable, no whitespace/quotes — anything else is
+    replaced with a generated id rather than poisoning the JSONL."""
+    return isinstance(tid, str) and bool(_ID_RE.match(tid))
+
+
+class TraceContext:
+    """One request's position in a trace tree: the shared ``trace_id``, this
+    request's own ``span_id`` (children parent to it) and the ``parent_id``
+    it answers to (the ingress root span for HTTP traffic; None for direct
+    API submits). Immutable in practice — migration moves the ``Request``
+    object itself, so the context rides along untouched."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_id: Optional[str] = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+
+    @classmethod
+    def new(cls, trace_id: Optional[str] = None) -> "TraceContext":
+        """A fresh ROOT context: new trace (or the caller's validated
+        ``trace_id``), new span id, no parent."""
+        if trace_id is None or not valid_trace_id(trace_id):
+            trace_id = _gen_id()
+        return cls(trace_id, _gen_id(), None)
+
+    def child(self) -> "TraceContext":
+        """A child context in the same trace, parented to this span."""
+        return TraceContext(self.trace_id, _gen_id(), self.span_id)
+
+    def to_json(self) -> list:
+        return [self.trace_id, self.span_id, self.parent_id]
+
+    @classmethod
+    def from_json(cls, data) -> Optional["TraceContext"]:
+        if not data:
+            return None
+        tid, sid, pid = data
+        return cls(str(tid), str(sid), None if pid is None else str(pid))
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return (
+            f"TraceContext(trace_id={self.trace_id!r}, "
+            f"span_id={self.span_id!r}, parent_id={self.parent_id!r})"
+        )
+
+
+class SpanRing:
+    """Bounded in-memory ring of recent span events — the flight recorder.
+    Thread-safe; ``snapshot()`` returns the events oldest-first. Disabling
+    (``set_enabled(False)``) makes ``append`` a no-op for overhead A/B runs
+    (bench ``serve_trace_overhead_*``)."""
+
+    def __init__(self, capacity: int = 4096):
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._enabled = True
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen or 0
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def set_enabled(self, on: bool) -> None:
+        self._enabled = bool(on)
+
+    def append(self, ev: dict) -> None:
+        if not self._enabled:
+            return
+        with self._lock:
+            self._ring.append(ev)
+
+    def snapshot(self) -> list:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+#: The process-wide flight recorder every ``emit_span`` feeds; ``/debugz``
+#: serves its snapshot. One ring for the process (spans carry ``src`` for
+#: per-server attribution) — dp replicas share it like the load gauges.
+FLIGHT_RECORDER = SpanRing()
+
 
 class TraceWriter:
-    """Append-only JSONL span writer; thread-safe; ``close()`` idempotent."""
+    """Append-only JSONL span writer; thread-safe; ``close()`` idempotent
+    (emit-after-close is a no-op). Rotates at ``max_bytes``: the current
+    file is renamed to ``<path>.1`` (replacing any previous rollover) and a
+    fresh file opened, so a long-lived daemon's trace is bounded at roughly
+    ``2 × max_bytes`` on disk."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, max_bytes: int = DEFAULT_TRACE_MAX_BYTES):
         self.path = path
+        self.max_bytes = int(max_bytes)
         self._lock = threading.Lock()
         self._f = open(path, "a", buffering=1)
+        try:
+            self._written = os.fstat(self._f.fileno()).st_size
+        except OSError:
+            self._written = 0
 
     def emit(self, span: str, dur_s: Optional[float] = None, **fields):
         ev = {"ts": time.time(), "span": span}
         if dur_s is not None:
             ev["dur_s"] = round(float(dur_s), 6)
         ev.update(fields)
+        self.write_event(ev)
+
+    def write_event(self, ev: dict) -> None:
         line = json.dumps(ev, sort_keys=True) + "\n"
         with self._lock:
-            if self._f is not None:
-                self._f.write(line)
+            if self._f is None:
+                return
+            if (
+                self.max_bytes > 0
+                and self._written + len(line) > self.max_bytes
+                and self._written > 0
+            ):
+                self._rotate()
+            self._f.write(line)
+            self._written += len(line)
+
+    def _rotate(self) -> None:
+        """Size-capped rollover (held under ``_lock``): close, rename the
+        full file to ``<path>.1`` (os.replace — the previous rollover is
+        overwritten) and reopen fresh. A rename failure (e.g. a sibling
+        process holding the file on a quirky filesystem) truncates in place
+        instead — the bound on disk use holds either way."""
+        self._f.close()
+        try:
+            os.replace(self.path, f"{self.path}.1")
+            self._f = open(self.path, "a", buffering=1)
+        except OSError:
+            self._f = open(self.path, "w", buffering=1)
+        self._written = 0
 
     def close(self) -> None:
         with self._lock:
             if self._f is not None:
                 self._f.close()
                 self._f = None
+
+
+def emit_span(
+    writer: Optional[TraceWriter],
+    span: str,
+    dur_s: Optional[float] = None,
+    trace: Optional[TraceContext] = None,
+    parent_of: Optional[TraceContext] = None,
+    **fields,
+):
+    """Emit one span event to the flight recorder AND ``writer`` (if any).
+
+    ``trace`` stamps the event as the context's OWN span (trace_id +
+    span_id + parent) — used for the ``ingress``/``request`` tree nodes.
+    ``parent_of`` stamps it as a CHILD of the context (trace_id + parent =
+    the context's span_id) — the common case for per-stage leaf spans.
+    Process-level decision spans pass neither."""
+    ev: dict = {"ts": time.time(), "span": span}
+    if dur_s is not None:
+        ev["dur_s"] = round(float(dur_s), 6)
+    if trace is not None:
+        ev["trace_id"] = trace.trace_id
+        ev["span_id"] = trace.span_id
+        if trace.parent_id is not None:
+            ev["parent"] = trace.parent_id
+    elif parent_of is not None:
+        ev["trace_id"] = parent_of.trace_id
+        ev["parent"] = parent_of.span_id
+    ev.update(fields)
+    FLIGHT_RECORDER.append(ev)
+    if writer is not None:
+        writer.write_event(ev)
+    return ev

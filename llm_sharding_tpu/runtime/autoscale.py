@@ -34,6 +34,7 @@ from typing import Callable, Optional
 from ..obs.metrics import (
     AUTOSCALE_DRAINS, AUTOSCALE_LOAD, AUTOSCALE_REPLICAS, AUTOSCALE_SPAWNS,
 )
+from ..obs.trace import emit_span
 
 logger = logging.getLogger("llm_sharding_tpu.autoscale")
 
@@ -62,6 +63,7 @@ class Autoscaler:
         clock: Callable[[], float] = time.monotonic,
         extra_load: Optional[Callable[[], int]] = None,
         load_fn: Optional[Callable[[], float]] = None,
+        rebalance_every_s: float = 0.0,
     ):
         if not 0 < scale_down_load < scale_up_load:
             raise ValueError(
@@ -98,6 +100,18 @@ class Autoscaler:
         self._lock = threading.Lock()
         self.spawns = 0
         self.drains = 0
+        # paced auto-rebalance (ROADMAP item 1d): every rebalance_every_s
+        # the tick also asks a disaggregated target to converge its
+        # prefill:decode ratio toward the planner's choice for the observed
+        # mix (DisaggServer.rebalance — one role flip per call, riding the
+        # same drain/spawn path as the scale actions). 0 = off; silently
+        # off when the target has no rebalance()/planner.
+        self.rebalance_every_s = float(rebalance_every_s)
+        self.rebalances = 0
+        self._next_rebalance_at = (
+            clock() + self.rebalance_every_s if self.rebalance_every_s > 0
+            else float("inf")
+        )
 
     # ------------------------------------------------------------ signal
 
@@ -158,6 +172,13 @@ class Autoscaler:
             else:
                 self._high_since = self._low_since = None
 
+            if (
+                self.rebalance_every_s > 0
+                and now >= self._next_rebalance_at
+            ):
+                self._next_rebalance_at = now + self.rebalance_every_s
+                self._maybe_rebalance()
+
             if now < self._cooldown_until:
                 return None
 
@@ -175,6 +196,10 @@ class Autoscaler:
                 AUTOSCALE_SPAWNS.inc()
                 self._cooldown_until = now + self.cooldown_s
                 self._high_since = None
+                emit_span(
+                    None, "autoscale", src="autoscaler", action="spawn",
+                    load=round(load, 3), live=len(self.target.servers),
+                )
                 logger.info(
                     "autoscale: spawned a replica at load %.2f (%d live)",
                     load, len(self.target.servers),
@@ -198,12 +223,33 @@ class Autoscaler:
                 AUTOSCALE_DRAINS.inc()
                 self._cooldown_until = now + self.cooldown_s
                 self._low_since = None
+                emit_span(
+                    None, "autoscale", src="autoscaler", action="drain",
+                    replica=d, load=round(load, 3),
+                    live=len(self.target.servers),
+                )
                 logger.info(
                     "autoscale: drained replica %d at load %.2f (%d live)",
                     d, load, len(self.target.servers),
                 )
                 return "drain"
             return None
+
+    def _maybe_rebalance(self) -> None:
+        """One paced role-rebalance attempt on a disaggregated target (a
+        no-op for plain routers and planner-less disagg routers). The flip
+        itself — and the drain/spawn it rides — emits its own decision
+        spans; failures are logged and never take the tick loop down."""
+        rebalance = getattr(self.target, "rebalance", None)
+        if rebalance is None or getattr(self.target, "planner", None) is None:
+            return
+        try:
+            flipped = rebalance()
+        except (ValueError, RuntimeError) as e:
+            logger.warning("autoscale rebalance refused: %s", e)
+            return
+        if flipped is not None:
+            self.rebalances += 1
 
     def _least_loaded_group(self) -> Optional[int]:
         """The device-group index of the live replica with the least work

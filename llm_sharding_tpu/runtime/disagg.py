@@ -52,6 +52,7 @@ holds on every path because each fallback is an already-proven path
 from __future__ import annotations
 
 import logging
+import time
 import weakref
 from typing import Optional
 
@@ -423,6 +424,7 @@ class DisaggServer(ReplicatedServer):
         return True
 
     def _handoff(self, req: Request, src: PipelineServer, attempts: int) -> bool:
+        t0 = time.perf_counter()
         dst = self._route_decode(exclude=src)
         if dst is None:
             # no decode-capable survivor: keep decoding on the prefill
@@ -432,6 +434,7 @@ class DisaggServer(ReplicatedServer):
             if req not in self._no_target_seen:
                 self._no_target_seen.add(req)
                 DISAGG_HANDOFFS.labels(outcome="no_target").inc()
+                self._decision("handoff", req=req, outcome="no_target")
             self._pending_handoff[req] = attempts
             return False
         self._no_target_seen.discard(req)
@@ -442,6 +445,10 @@ class DisaggServer(ReplicatedServer):
         if not self._can_adopt(dst, req.prompt_len + fresh, remaining):
             self._no_handoff.add(req)
             DISAGG_HANDOFFS.labels(outcome="fallback").inc()
+            self._decision(
+                "handoff", req=req, outcome="fallback",
+                reason="no_layout", attempts=attempts,
+            )
             logger.warning(
                 "request %d's resumed prompt (%d tokens, %d remaining) "
                 "does not lay out on the decode side — decoding stays on "
@@ -457,6 +464,10 @@ class DisaggServer(ReplicatedServer):
                 if is_transient(e) and attempts < self.handoff_retries:
                     self._pending_handoff[req] = attempts + 1
                     DISAGG_HANDOFFS.labels(outcome="retried").inc()
+                    self._decision(
+                        "handoff", req=req, outcome="retried",
+                        attempts=attempts + 1,
+                    )
                     logger.warning(
                         "transient kv_handoff fault for request %d "
                         "(attempt %d/%d): %r — retrying next sweep",
@@ -465,6 +476,10 @@ class DisaggServer(ReplicatedServer):
                 else:
                     self._no_handoff.add(req)
                     DISAGG_HANDOFFS.labels(outcome="fallback").inc()
+                    self._decision(
+                        "handoff", req=req, outcome="fallback",
+                        reason="fault", attempts=attempts,
+                    )
                     logger.warning(
                         "kv_handoff fault for request %d: %r — decoding "
                         "stays on replica %d",
@@ -479,9 +494,9 @@ class DisaggServer(ReplicatedServer):
                 self._pending_handoff[req] = attempts
             logger.info("hand-off of request %d deferred: %s", req.id, e)
             return False
-        streamed = 0
+        streamed = nbytes = 0
         try:
-            streamed = self._stream_prefix(src, dst, st.prompt)
+            streamed, nbytes = self._stream_prefix(src, dst, st.prompt)
         except Exception:  # noqa: BLE001 — streaming is an optimization:
             # a failed transfer degrades to a cold (re-prefilling) adopt,
             # token-identical by the chunked-prefill argument
@@ -503,6 +518,11 @@ class DisaggServer(ReplicatedServer):
                 self._owner[req] = t
                 self._no_handoff.add(req)
                 DISAGG_HANDOFFS.labels(outcome="fallback").inc()
+                self._decision(
+                    "handoff", req=req, dur_s=time.perf_counter() - t0,
+                    outcome="fallback", reason="refused_adopt",
+                    dst=self._group_of[t], attempts=attempts,
+                )
                 logger.warning(
                     "hand-off target refused request %d; adopted by "
                     "replica %d instead", req.id, self._group_of[t],
@@ -513,6 +533,10 @@ class DisaggServer(ReplicatedServer):
                 f"anywhere: {last!r}", req,
             ))
             DISAGG_HANDOFFS.labels(outcome="failed").inc()
+            self._decision(
+                "handoff", req=req, dur_s=time.perf_counter() - t0,
+                outcome="failed", attempts=attempts,
+            )
             return True
         self._owner[req] = dst
         # "ok" = the decode side resumes from cached KV (bytes streamed
@@ -522,6 +546,12 @@ class DisaggServer(ReplicatedServer):
             np.asarray(st.prompt, np.int32)
         ) > 0
         DISAGG_HANDOFFS.labels(outcome="ok" if warm else "cold").inc()
+        self._decision(
+            "handoff", req=req, dur_s=time.perf_counter() - t0,
+            outcome="ok" if warm else "cold",
+            frm=self._group_of[src], dst=self._group_of[dst],
+            streamed=streamed, bytes=nbytes, attempts=attempts,
+        )
         logger.info(
             "hand-off id=%d replica %d → %d (%d prefix tokens streamed, "
             "%d generated so far)",
@@ -534,31 +564,31 @@ class DisaggServer(ReplicatedServer):
 
     def _stream_prefix(
         self, src: PipelineServer, dst: PipelineServer, prompt
-    ) -> int:
+    ) -> tuple:
         """Stream ``src``'s longest radix match for ``prompt`` into
         ``dst``'s tree through host RAM: device→host copy of the matched
         arena blocks on ``src`` (codes+scales when quantized), fresh block
         allocation + donating scatter on ``dst``, then a radix insert so
-        the very next admission takes the hit. Returns tokens landed (0 =
-        nothing worth streaming / no room — the caller's adopt simply
-        re-prefills, token-identically). Locks are taken one replica at a
-        time (read side, then write side) — never nested."""
+        the very next admission takes the hit. Returns ``(tokens, bytes)``
+        landed ((0, 0) = nothing worth streaming / no room — the caller's
+        adopt simply re-prefills, token-identically). Locks are taken one
+        replica at a time (read side, then write side) — never nested."""
         ids = np.asarray(prompt, np.int32).reshape(-1)
         if src._radix is None or dst._radix is None:
-            return 0
+            return 0, 0
         if (
             dst.kv_block_size != src.kv_block_size
             or dst.kv_dtype != src.kv_dtype
         ):
-            return 0  # heterogeneous pools cannot exchange raw blocks
+            return 0, 0  # heterogeneous pools cannot exchange raw blocks
         bs = src.kv_block_size
         with src._mutex:
             n = src._radix.match_tokens(ids)
             if n <= 0:
-                return 0
+                return 0, 0
             ref = src._radix.take(ids, n)
             if ref is None:
-                return 0
+                return 0, 0
             try:
                 n = ref.n
                 kv = src._read_arena_blocks(ref.blocks)
@@ -567,7 +597,7 @@ class DisaggServer(ReplicatedServer):
         with dst._mutex:
             have = dst._radix.match_tokens(ids[:n])
             if have >= n:
-                return 0  # destination already at least as warm
+                return 0, 0  # destination already at least as warm
             nb_have, nb_all = have // bs, n // bs
             need = nb_all - nb_have
             cov: list[int] = []
@@ -580,15 +610,15 @@ class DisaggServer(ReplicatedServer):
                 if cref is None or cref.n != have:
                     if cref is not None:
                         dst._radix.release(cref)
-                    return 0
+                    return 0, 0
                 cov = list(cref.blocks)
             try:
                 if not dst._radix.ensure_free(need):
-                    return 0
+                    return 0, 0
                 try:
                     fresh = dst._alloc.alloc(need)
                 except BlockExhausted:
-                    return 0
+                    return 0, 0
                 tail = tuple(
                     np.ascontiguousarray(a[:, :, nb_have:nb_all])
                     for a in kv
@@ -603,12 +633,14 @@ class DisaggServer(ReplicatedServer):
                 if leftover:
                     dst._alloc.free(leftover)
                 landed = len(consumed)
+                nbytes = 0
                 if landed:
                     per_block = sum(
                         a.nbytes // max(a.shape[2], 1) for a in tail
                     )
-                    HANDOFF_BYTES.inc(per_block * landed)
-                return landed * bs
+                    nbytes = per_block * landed
+                    HANDOFF_BYTES.inc(nbytes)
+                return landed * bs, nbytes
             finally:
                 if cref is not None:
                     dst._radix.release(cref)
@@ -631,7 +663,8 @@ class DisaggServer(ReplicatedServer):
         if best is None or bn - have < (dst.kv_block_size or 1):
             return 0
         try:
-            return self._stream_prefix(best, dst, prompt[:bn])
+            tokens, _ = self._stream_prefix(best, dst, prompt[:bn])
+            return tokens
         except Exception:  # noqa: BLE001 — a failed fill is a cold prefill
             logger.exception("cross-replica radix fill failed")
             return 0
@@ -714,6 +747,10 @@ class DisaggServer(ReplicatedServer):
             d = min(cands, key=lambda g: self._load(self._by_group[g]))
             self.drain(d)
             self.spawn_replica(group=d, role=to)
+            self._decision(
+                "rebalance", replica=d, frm=frm, to=to,
+                want_prefill=want, live=len(live),
+            )
             logger.info(
                 "rebalance: replica %d flipped %s → %s (planner wants %d "
                 "prefill of %d for mix ~%d prompt / ~%d new tokens)",

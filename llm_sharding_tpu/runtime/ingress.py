@@ -53,6 +53,7 @@ from ..obs.http import write_ignoring_disconnect
 from ..obs.metrics import (
     INGRESS_ACTIVE, INGRESS_QUEUED, INGRESS_REQUESTS, INGRESS_TTFT,
 )
+from ..obs.trace import TraceContext, TraceWriter, emit_span
 from .fairness import (
     FairQueue, GlobalQueueFull, RateLimited, TenantConfig, TenantQueueFull,
     UnknownTenant, load_tenants_config,
@@ -79,6 +80,7 @@ class _Pending:
         "tenant", "prompt", "prompt_len", "max_new", "temperature", "seed",
         "top_k", "top_p", "stop", "stream", "arrived_at", "deadline_at",
         "event", "req", "shed", "charged", "rid", "interrupted", "embeds",
+        "trace", "outcome",
     )
 
     def __init__(self, tenant, prompt, prompt_len, rid):
@@ -101,6 +103,10 @@ class _Pending:
         self.shed: Optional[tuple] = None  # (code, outcome, retry_after, msg)
         self.charged = 0
         self.interrupted = False  # stop() cancelled the row mid-decode
+        # the trace ROOT for this HTTP request (X-Trace-Id honored, else
+        # generated); the backend Request's span becomes its child
+        self.trace = TraceContext.new()
+        self.outcome: Optional[str] = None
 
 
 class IngressServer:
@@ -126,8 +132,16 @@ class IngressServer:
         autoscaler=None,
         poll_interval_s: float = 0.001,
         autoscale_interval_s: float = 0.05,
+        trace_path: Optional[str] = None,
     ):
         self.backend = backend
+        # ingress-side spans (the per-trace ROOT + fair-queue wait) get
+        # their own JSONL file — the backend files are per replica, and the
+        # ingress runs on its own threads. trace-report merges them by
+        # trace_id. Spans land in the flight recorder regardless.
+        self._trace = (
+            TraceWriter(f"{trace_path}.ingress") if trace_path else None
+        )
         self.tokenizer = tokenizer
         self.model_name = model_name
         self.default_max_new = int(default_max_new)
@@ -308,6 +322,8 @@ class IngressServer:
             pass
         self._httpd.shutdown()
         self._httpd.server_close()
+        if self._trace is not None:
+            self._trace.close()
         self._started = False
 
     @property
@@ -393,7 +409,8 @@ class IngressServer:
                     did = True
                     continue
                 kw = dict(
-                    temperature=e.temperature, seed=e.seed, tenant=tenant
+                    temperature=e.temperature, seed=e.seed, tenant=tenant,
+                    trace=e.trace,
                 )
                 if e.top_k is not None:
                     kw["top_k"] = e.top_k
@@ -431,6 +448,13 @@ class IngressServer:
                 # prefill service is known at dispatch; decode accrues in
                 # _charge_and_reap
                 self.fair.charge(tenant, e.prompt_len, kind="prefill")
+                # the fair-queue wait, attributed: arrival → backend submit
+                emit_span(
+                    self._trace, "queue",
+                    dur_s=time.monotonic() - e.arrived_at,
+                    parent_of=e.trace, src="ingress",
+                    tenant=tenant, rid=e.rid,
+                )
                 e.req = req
                 with self._mutex:
                     self._live.append(e)
@@ -483,6 +507,27 @@ class IngressServer:
             tenant=tenant or "unknown", outcome=outcome
         ).inc()
 
+    def _count_entry(self, e: _Pending, outcome: str) -> None:
+        """Outcome accounting for a DISPATCHED entry: the counter plus the
+        outcome the ingress root span reports at the end of the request."""
+        e.outcome = outcome
+        self._count(e.tenant, outcome)
+
+    def _finish_trace(self, e: _Pending, outcome: str) -> None:
+        """Close the trace tree's ROOT: the ingress span covering the whole
+        HTTP request (arrival → last byte), with its outcome. Every other
+        span of the trace — fair-queue wait, backend request and its
+        children, hand-off — parents up to this one."""
+        fields: dict = {"tenant": e.tenant, "rid": e.rid, "outcome": outcome}
+        if e.req is not None:
+            fields["id"] = e.req.id
+            fields["tokens"] = len(e.req.tokens)
+        emit_span(
+            self._trace, "ingress",
+            dur_s=time.monotonic() - e.arrived_at,
+            trace=e.trace, src="ingress", **fields,
+        )
+
     def _reject(self, reason: str) -> None:
         # the same counter family the backend's admission control feeds —
         # one place to alert on every early shed, wherever it happened
@@ -520,8 +565,14 @@ class IngressServer:
             def _error(
                 self, code: int, etype: str, msg: str,
                 retry_after: Optional[float] = None,
+                trace_id: Optional[str] = None,
             ) -> None:
                 headers = []
+                if trace_id is not None:
+                    # rejections echo the trace id too — an upstream that
+                    # propagated X-Trace-Id can tie its 429/503/504 back
+                    # to the (single-span) trace this side recorded
+                    headers.append(("X-Trace-Id", trace_id))
                 if retry_after is not None:
                     # ceil to a whole second: Retry-After is integer
                     # seconds per RFC 9110, and "0" would invite an
@@ -667,6 +718,11 @@ class IngressServer:
         if stop is not None:
             e.stop = (stop,) if isinstance(stop, str) else tuple(stop)
         e.stream = bool(body.get("stream", False))
+        tid = handler.headers.get("X-Trace-Id")
+        if tid is not None:
+            # caller-supplied trace id (Dapper-style propagation from an
+            # upstream service); malformed values fall back to generated
+            e.trace = TraceContext.new(trace_id=tid)
         dl_ms = handler.headers.get("X-Deadline-Ms")
         if dl_ms is not None:
             dl_ms = float(dl_ms)
@@ -720,21 +776,29 @@ class IngressServer:
         except RateLimited as err:
             self._count(tenant, "rejected_rate")
             self._reject("rate_limit")
-            handler._error(429, "rate_limited", str(err), err.retry_after_s)
+            handler._error(
+                429, "rate_limited", str(err), err.retry_after_s,
+                trace_id=e.trace.trace_id,
+            )
+            self._finish_trace(e, "rejected_rate")
             return
         except TenantQueueFull as err:
             self._count(tenant, "rejected_tenant_queue")
             self._reject("tenant_queue_full")
             handler._error(
-                429, "tenant_queue_full", str(err), err.retry_after_s
+                429, "tenant_queue_full", str(err), err.retry_after_s,
+                trace_id=e.trace.trace_id,
             )
+            self._finish_trace(e, "rejected_tenant_queue")
             return
         except GlobalQueueFull as err:
             self._count(tenant, "rejected_overload")
             self._reject("ingress_queue_full")
             handler._error(
-                503, "overloaded", str(err), OVERLOAD_RETRY_AFTER_S
+                503, "overloaded", str(err), OVERLOAD_RETRY_AFTER_S,
+                trace_id=e.trace.trace_id,
             )
+            self._finish_trace(e, "rejected_overload")
             return
         INGRESS_QUEUED.set(self.fair.depth())
 
@@ -746,8 +810,9 @@ class IngressServer:
                     self._reject("draining")
                     handler._error(
                         503, "draining", "server shutting down",
-                        OVERLOAD_RETRY_AFTER_S,
+                        OVERLOAD_RETRY_AFTER_S, trace_id=e.trace.trace_id,
                     )
+                    self._finish_trace(e, "rejected_draining")
                     return
         if e.shed is not None:
             code, outcome, retry_after, msg = e.shed
@@ -758,7 +823,11 @@ class IngressServer:
                 self._reject("deadline")
             elif outcome == "rejected_draining":
                 self._reject("draining")
-            handler._error(code, outcome, msg or outcome, retry_after)
+            handler._error(
+                code, outcome, msg or outcome, retry_after,
+                trace_id=e.trace.trace_id,
+            )
+            self._finish_trace(e, outcome)
             return
 
         # -- dispatched: stream or collect ---------------------------------
@@ -774,6 +843,7 @@ class IngressServer:
                 except ValueError:
                     pass
                 INGRESS_ACTIVE.set(len(self._live))
+            self._finish_trace(e, e.outcome or "unknown")
 
     # ------------------------------------------------------------ responses
 
@@ -820,7 +890,8 @@ class IngressServer:
             idx += len(batch)
             if batch and first:
                 INGRESS_TTFT.labels(tenant=e.tenant).observe(
-                    time.monotonic() - e.arrived_at
+                    time.monotonic() - e.arrived_at,
+                    trace_id=e.trace.trace_id,
                 )
                 first = False
             if done:
@@ -828,10 +899,13 @@ class IngressServer:
             time.sleep(self._poll_s)
         if error is not None:
             code, outcome, retry_after = self._classify_failure(error)
-            self._count(e.tenant, outcome)
+            self._count_entry(e, outcome)
             if outcome == "deadline":
                 self._reject("deadline")
-            handler._error(code, outcome, str(error), retry_after)
+            handler._error(
+                code, outcome, str(error), retry_after,
+                trace_id=e.trace.trace_id,
+            )
             return
         text = ""
         if self.tokenizer is not None:
@@ -848,8 +922,11 @@ class IngressServer:
                 "finish_reason": self._finish_reason(e),
             }],
             "usage": self._usage(e),
-        }, [("X-Request-Id", f"cmpl-{req.id}")])
-        self._count(e.tenant, self._final_outcome(e))
+        }, [
+            ("X-Request-Id", f"cmpl-{req.id}"),
+            ("X-Trace-Id", e.trace.trace_id),
+        ])
+        self._count_entry(e, self._final_outcome(e))
 
     def _sse_write(self, handler, e: _Pending, obj: dict) -> bool:
         """One SSE event. An injected ``slow_client`` fault is a simulated
@@ -869,6 +946,7 @@ class IngressServer:
         handler.send_header("Cache-Control", "no-cache")
         handler.send_header("Connection", "close")
         handler.send_header("X-Request-Id", f"cmpl-{req.id}")
+        handler.send_header("X-Trace-Id", e.trace.trace_id)
         handler.end_headers()
         base = {
             "id": f"cmpl-{req.id}",
@@ -884,7 +962,8 @@ class IngressServer:
             if batch:
                 if first:
                     INGRESS_TTFT.labels(tenant=e.tenant).observe(
-                        time.monotonic() - e.arrived_at
+                        time.monotonic() - e.arrived_at,
+                        trace_id=e.trace.trace_id,
                     )
                     first = False
                 acc.extend(batch)
@@ -908,7 +987,7 @@ class IngressServer:
         if error is not None:
             code, outcome, _ = self._classify_failure(error)
             del code  # the SSE status line already went out as 200
-            self._count(e.tenant, outcome)
+            self._count_entry(e, outcome)
             if outcome == "deadline":
                 self._reject("deadline")
             ev = dict(base)
@@ -930,13 +1009,13 @@ class IngressServer:
             self._disconnect(e)
             return
         handler._write(b"data: [DONE]\n\n")
-        self._count(e.tenant, self._final_outcome(e))
+        self._count_entry(e, self._final_outcome(e))
 
     def _disconnect(self, e: _Pending) -> None:
         """The client went away mid-stream: cancel the backend row so its
         slot AND its KV blocks free immediately — an abandoned stream
         must never hold arena blocks to completion."""
-        self._count(e.tenant, "disconnect")
+        self._count_entry(e, "disconnect")
         try:
             self.backend.cancel(e.req)
         except Exception:  # noqa: BLE001 — cancel is best-effort here; the

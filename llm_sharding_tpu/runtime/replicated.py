@@ -68,6 +68,7 @@ from ..obs.metrics import (
     REPLICA_DRAINS, REPLICA_FAILOVERS, REPLICA_SPAWNS, REQUESTS_MIGRATED,
     set_replica_state,
 )
+from ..obs.trace import TraceWriter, emit_span
 from ..parallel.placement import PlacementSpec
 
 from .engine import PipelineEngine
@@ -152,8 +153,16 @@ class ReplicatedServer:
         self._host_params = jax.tree.map(np.asarray, params)
         # one JSONL trace file PER REPLICA (suffix .r<d>, d = device-group
         # index): replicas step on independent threads of control — a shared
-        # file would interleave their spans with no way to attribute them
+        # file would interleave their spans with no way to attribute them.
+        # ROUTER-level events (failover/drain/spawn decisions, per-request
+        # migrations, disagg hand-offs) get their own .router file; every
+        # span carries a trace_id where applicable, so trace-report merges
+        # the whole set back into per-request trees.
         self._trace_path = serve_kwargs.pop("trace_path", None)
+        self._router_trace = (
+            TraceWriter(f"{self._trace_path}.router")
+            if self._trace_path else None
+        )
         # auto-snapshots likewise: one directory per replica, or D daemons
         # would race the same atomic rename
         self._snapshot_path = serve_kwargs.pop("snapshot_path", None)
@@ -226,6 +235,7 @@ class ReplicatedServer:
             ),
             **self._serve_kwargs,
         )
+        srv._span_src = f"r{d}"  # flight-recorder spans name their replica
         self.engines.append(eng)
         self.servers.append(srv)
         self._by_group[d] = srv
@@ -252,6 +262,19 @@ class ReplicatedServer:
         if self._gauge_state.get(d) != state:
             self._gauge_state[d] = state
             set_replica_state(d, state)
+
+    def _decision(self, name: str, req=None, dur_s=None, **fields):
+        """Router-level span (failover/drain/spawn decisions, per-request
+        migrations): flight recorder + the .router JSONL file. ``req``
+        attributes the span to the request's trace like the servers'
+        per-stage spans."""
+        if req is not None:
+            fields.setdefault("id", req.id)
+        emit_span(
+            self._router_trace, name, dur_s=dur_s,
+            parent_of=None if req is None else req.trace,
+            src="router", **fields,
+        )
 
     # ------------------------------------------------------------------ API
 
@@ -466,6 +489,7 @@ class ReplicatedServer:
             "its live requests", d, err,
         )
         REPLICA_FAILOVERS.inc()
+        self._decision("failover", replica=d, error=repr(err)[:200])
         self._set_replica_gauge(d, "QUARANTINED")
         self._retire(s)
         moved, failed = self._migrate_all(s, err)
@@ -526,6 +550,10 @@ class ReplicatedServer:
                     continue
                 self._owner[req] = t
                 REQUESTS_MIGRATED.labels(outcome="ok").inc()
+                self._decision(
+                    "migrate", req=req, outcome="ok",
+                    dst=self._group_of.get(t, -1),
+                )
                 adopted = True
                 moved += 1
                 break
@@ -538,6 +566,7 @@ class ReplicatedServer:
                     req,
                 ))
                 REQUESTS_MIGRATED.labels(outcome="failed").inc()
+                self._decision("migrate", req=req, outcome="failed")
                 failed += 1
         return moved, failed
 
@@ -605,6 +634,7 @@ class ReplicatedServer:
             except Exception:  # noqa: BLE001
                 logger.exception("drain: close of replica %d raised", d)
             REPLICA_DRAINS.inc()
+            self._decision("drain", replica=d, moved=moved, failed=failed)
             self._set_replica_gauge(d, "OFFLINE")
             logger.info(
                 "replica %d drained: %d migrated, %d failed; %d replica(s) "
@@ -640,6 +670,7 @@ class ReplicatedServer:
             d = free[0]
             srv = self._spawn_on_group(d)
             REPLICA_SPAWNS.inc()
+            self._decision("spawn", replica=d)
             logger.info(
                 "replica spawned on group %d; %d replica(s) live",
                 d, len(self.servers),
@@ -792,6 +823,8 @@ class ReplicatedServer:
                 else:
                     if d is not None:
                         self._set_replica_gauge(d, s.health)
+            if self._router_trace is not None:
+                self._router_trace.close()
             if errs:
                 detail = "; ".join(f"replica {d}: {e!r}" for d, e in errs)
                 raise RuntimeError(

@@ -79,7 +79,7 @@ from ..obs.metrics import (
     KV_BLOCKS_IN_USE, KV_BLOCKS_TOTAL, KV_HOST_TIER_BLOCKS, KV_WASTE_FRAC,
     PREFIX_HIT_RATE, PREFIX_HIT_TOKENS, REGISTRY, record_shape_key,
 )
-from ..obs.trace import TraceWriter
+from ..obs.trace import TraceContext, TraceWriter, emit_span
 from ..parallel import serve as serve_ops
 from ..parallel.mesh import PIPE_AXIS
 from .faults import backoff_delays, is_transient
@@ -290,6 +290,11 @@ def _update_health_gauge() -> None:
     if not states:
         states = [s._health for s in list(_LIVE_SERVERS)] or [SERVING]
     _M_HEALTH.set_state(max(states, key=_HEALTH_SEVERITY.__getitem__))
+
+# Bucketed decode spans: one ``decode`` span per this many committed tokens
+# per request (plus the remainder at completion) — span volume stays
+# O(tokens / 32), not O(tokens), so tracing is cheap enough to leave on.
+DECODE_SPAN_TOKENS = 32
 
 # Admission prompt buckets: each one a compiled serve_admit shape (compiles
 # happen only for buckets actually used; the ladder tops out at 32k so long-
@@ -697,6 +702,12 @@ class Request:
         #           trigger dispatches behind the in-flight decode chunk
         #           instead of serializing with the admission — released
         #           on every path that removes the request from the queue
+        "trace",  # TraceContext: the request's span identity (trace_id +
+        #           this request's span id + the ingress parent). Rides the
+        #           Request object through migration/snapshot so every
+        #           replica's spans join one cross-replica tree
+        "decode_mark",  # (tokens_at_last_decode_span, perf_counter) — the
+        #           bucketed decode-span emitter's per-request cursor
         "__weakref__",  # the dp router tracks request→replica ownership
     )
 
@@ -714,6 +725,9 @@ class Request:
         prefix: Optional["PrefixHandle"] = None,  # shared-prefix KV handle
         deadline_s: Optional[float] = None,  # relative deadline at submit
         tenant: Optional[str] = None,  # ingress tenant metadata
+        trace: Optional[TraceContext] = None,  # PARENT context (the ingress
+        #           root span); the request's own span becomes its child.
+        #           None → a fresh root trace is born here at submit
     ):
         self.id = rid
         self.prompt = prompt
@@ -738,6 +752,8 @@ class Request:
         self.carried_rng: Optional[np.ndarray] = None
         self.tenant = tenant
         self.staged_radix = None
+        self.trace = trace.child() if trace is not None else TraceContext.new()
+        self.decode_mark = None
         self.submitted_at = time.perf_counter()
         self.deadline_at = (
             None if deadline_s is None else self.submitted_at + deadline_s
@@ -1105,8 +1121,12 @@ class PipelineServer:
         self.counters = Counters()
         # optional JSONL span trace (obs/trace.py). Deliberately NOT part of
         # serve_kwargs in snapshot(): an observability knob, not serving
-        # state — the checkpoint format is unchanged.
+        # state — the checkpoint format is unchanged. Spans ALWAYS land in
+        # the process-wide flight-recorder ring (served by /debugz) whether
+        # or not a file is configured; _span_src names this server in them
+        # (the dp router overwrites it with the replica's group label).
         self._trace = TraceWriter(trace_path) if trace_path else None
+        self._span_src = "s0"
 
         from ..ops.quant import QTensor
 
@@ -1321,6 +1341,7 @@ class PipelineServer:
         prefix: Optional[PrefixHandle] = None,
         deadline_s: Optional[float] = None,
         tenant: Optional[str] = None,
+        trace: Optional[TraceContext] = None,
     ) -> Request:
         """Enqueue a request (≙ ``receive_user_request``, admission happens
         on the next ``step``). ``temperature > 0`` samples with this
@@ -1368,7 +1389,7 @@ class PipelineServer:
                 self._new_id(), prompt, max_new_tokens,
                 temperature=temperature, seed=seed, top_k=top_k, top_p=top_p,
                 stop=stop, prefix=prefix, deadline_s=deadline_s,
-                tenant=tenant,
+                tenant=tenant, trace=trace,
             )
             if self.speculate:
                 from .spec import AdaptiveK
@@ -1506,6 +1527,9 @@ class PipelineServer:
                     # prompt, and a not-yet-consumed carried sampling chain
                     "baked": r.baked,
                     "tenant": r.tenant,
+                    # trace identity survives the process: the revived
+                    # daemon's spans join the same cross-process tree
+                    "trace": r.trace.to_json(),
                     "carried_rng": (
                         None if r.carried_rng is None
                         else [int(x) for x in r.carried_rng]
@@ -1705,6 +1729,9 @@ class PipelineServer:
             # .get(): format-1/2 snapshots predate migration bookkeeping
             r.baked = int(d.get("baked", 0) or 0)
             r.tenant = d.get("tenant")  # pre-ingress snapshots lack it
+            tr = TraceContext.from_json(d.get("trace"))
+            if tr is not None:  # pre-tracing snapshots keep the fresh ctx
+                r.trace = tr
             cr = d.get("carried_rng")
             r.carried_rng = None if cr is None else np.asarray(cr, np.uint32)
             if d.get("deadline_left") is not None:
@@ -1726,6 +1753,7 @@ class PipelineServer:
                 # process — backfill so the first post-restore token doesn't
                 # record a spurious near-zero TTFT sample
                 r.first_token_at = r.last_token_at = time.perf_counter()
+                r.decode_mark = (len(r.tokens), r.first_token_at)
             return r
 
         srv._rows = [req_from(d) for d in snap["rows"]]
@@ -1828,6 +1856,7 @@ class PipelineServer:
         stop=None,
         deadline_s: Optional[float] = None,
         tenant: Optional[str] = None,
+        trace: Optional[TraceContext] = None,
     ) -> Request:
         """Enqueue a request that enters as EMBEDDINGS — the privacy entry
         (≙ the reference's request-injection channel: an embedding-capable
@@ -1864,6 +1893,7 @@ class PipelineServer:
                 self._new_id(), np.zeros((0,), np.int32), max_new_tokens,
                 temperature=temperature, seed=seed, top_k=top_k, top_p=top_p,
                 stop=stop, embeds=h, deadline_s=deadline_s, tenant=tenant,
+                trace=trace,
             )
             if self.speculate:
                 from .spec import AdaptiveK
@@ -1949,8 +1979,7 @@ class PipelineServer:
             dt_apply = time.perf_counter() - t0
             if progressed or applied:
                 _M_STEP_PHASE.labels(phase="apply").observe(dt_apply)
-                if self._trace:
-                    self._trace.emit("apply", dur_s=dt_apply, applied=applied)
+                self._span("apply", dur_s=dt_apply, applied=applied)
                 _update_load_gauges()
             if self._radix is not None and self._queue:
                 # stage the NEXT admission's radix plan now, AFTER this
@@ -2038,10 +2067,7 @@ class PipelineServer:
         )
         dt_dispatch = time.perf_counter() - t0
         _M_STEP_PHASE.labels(phase="dispatch").observe(dt_dispatch)
-        if self._trace:
-            self._trace.emit(
-                "chunk", dur_s=dt_dispatch, m0=self._m, cycles=cycles,
-            )
+        self._span("chunk", dur_s=dt_dispatch, m0=self._m, cycles=cycles)
         self._m += cycles
         self.counters.inc("chunks")
 
@@ -2174,6 +2200,12 @@ class PipelineServer:
                 req.finished_at = time.perf_counter()
                 self._release_staged(req)
                 self.counters.inc("requests_cancelled")
+                emit_span(
+                    self._trace, "request",
+                    dur_s=req.finished_at - req.submitted_at,
+                    trace=req.trace, src=self._span_src,
+                    id=req.id, tokens=0, outcome="cancelled",
+                )
                 _update_load_gauges()
                 return True
             if self._rows[req.row] is not req:
@@ -2188,6 +2220,12 @@ class PipelineServer:
             # before anything could cancel it) — index it like a finish
             self._release_row_blocks(req.row, req=req, insert=True)
             self.counters.inc("requests_cancelled")
+            emit_span(
+                self._trace, "request",
+                dur_s=req.finished_at - req.submitted_at,
+                trace=req.trace, src=self._span_src,
+                id=req.id, tokens=len(req.tokens), outcome="cancelled",
+            )
             _update_load_gauges()
         logger.info("cancel id=%d row=%d tokens=%d", req.id, req.row,
                     len(req.tokens))
@@ -2234,6 +2272,18 @@ class PipelineServer:
             self.step()
 
     # ------------------------------------------------------------ internals
+
+    def _span(self, name, dur_s=None, req: Optional[Request] = None, **fields):
+        """Emit one span to the flight recorder + this server's JSONL trace.
+        With ``req``, the span joins the request's trace as a CHILD of its
+        ``request`` span (plus the request id for grepping)."""
+        if req is not None:
+            fields.setdefault("id", req.id)
+        emit_span(
+            self._trace, name, dur_s=dur_s,
+            parent_of=None if req is None else req.trace,
+            src=self._span_src, **fields,
+        )
 
     def _new_id(self) -> int:
         rid = self._next_id
@@ -2706,6 +2756,10 @@ class PipelineServer:
                 # the chain state consistent with the tokens consumers got:
                 # one split per committed token, from key(seed)
                 rng = rng_chain_at(req.seed, len(req.tokens))
+            self._span(
+                "extract", req=req, tokens=len(req.tokens),
+                remaining=remaining,
+            )
             _update_load_gauges()
         logger.info(
             "extract id=%d tokens=%d remaining=%d rng=%s",
@@ -2760,6 +2814,13 @@ class PipelineServer:
                 req.done = True
                 req.finished_at = time.perf_counter()
                 self.counters.inc("requests_completed")
+                # close the trace tree (no further tokens will do it)
+                emit_span(
+                    self._trace, "request",
+                    dur_s=req.finished_at - req.submitted_at,
+                    trace=req.trace, src=self._span_src,
+                    id=req.id, tokens=len(req.tokens),
+                )
                 return
             if state.embeds is not None:
                 h = np.asarray(state.embeds, self._act_dtype)
@@ -2825,6 +2886,11 @@ class PipelineServer:
                 self._queue.appendleft(req)
             else:
                 self._queue.append(req)
+            self._span(
+                "adopt", req=req, resumed_prompt=req.prompt_len,
+                remaining=remaining,
+                carried_rng=req.carried_rng is not None,
+            )
             _update_load_gauges()
         logger.info(
             "adopt id=%d resumed_prompt=%d remaining=%d carried_rng=%s",
@@ -2884,6 +2950,20 @@ class PipelineServer:
             self._rows[req.row] = None
             self._release_row_blocks(req.row)
         self.counters.inc("requests_failed")
+        # the trace tree must close for FAILED requests too — the flight
+        # recorder's whole point is explaining the request that never made
+        # it (a 504's postmortem has a "request" span with its error)
+        span = dict(
+            id=req.id, tokens=len(req.tokens), outcome="failed",
+            error=repr(err)[:200],
+        )
+        if req.tenant is not None:
+            span["tenant"] = req.tenant
+        emit_span(
+            self._trace, "request",
+            dur_s=req.finished_at - req.submitted_at,
+            trace=req.trace, src=self._span_src, **span,
+        )
 
     def _contain_rows(self, site: str, victims: list, err) -> None:
         """Contain a persistent failure to exactly ``victims`` (row, req)
@@ -3300,7 +3380,10 @@ class PipelineServer:
                     r.carried_rng = None  # consumed by this admission
                 r.row = slot * Bs + i
                 r.started_at = time.perf_counter()
-                _M_QUEUE_WAIT.observe(r.started_at - r.submitted_at)
+                _M_QUEUE_WAIT.observe(
+                    r.started_at - r.submitted_at,
+                    trace_id=r.trace.trace_id,
+                )
                 self._rows[r.row] = r
                 # mirrors track TOTAL (prefix-inclusive) lengths — they
                 # replay the device's absolute-position bookkeeping
@@ -3461,11 +3544,25 @@ class PipelineServer:
                 continue
             self.counters.inc("admissions")
             admitted = True
-            if self._trace:
-                self._trace.emit(
-                    "admit", dur_s=time.perf_counter() - t_admit0, slot=slot,
-                    ids=[r.id for r in batch], bucket=bucket,
-                    chunked=self._chunked(bucket), n=len(batch),
+            dt_admit = time.perf_counter() - t_admit0
+            self._span(
+                "admit", dur_s=dt_admit, slot=slot,
+                ids=[r.id for r in batch], bucket=bucket,
+                chunked=self._chunked(bucket), n=len(batch),
+            )
+            for r in batch:
+                if self._radix is not None and pfx is None and not is_emb:
+                    # cache consult outcome: hit tokens vs the prompt (miss
+                    # = prompt - hit prefilled cold) — the span that answers
+                    # "was this slow request a radix miss?"
+                    self._span(
+                        "radix", req=r, hit=spx_n, prompt=r.prompt_len,
+                    )
+                self._span(
+                    "prefill", dur_s=dt_admit, req=r, slot=slot,
+                    bucket=bucket, chunked=self._chunked(bucket),
+                    n=len(batch),
+                    queue_wait_s=round(r.started_at - r.submitted_at, 6),
                 )
             logger.info(
                 "admit slot=%d ids=%s bucket=%d chunked=%s in_flight=%d",
@@ -3787,11 +3884,30 @@ class PipelineServer:
         now = time.perf_counter()
         if req.first_token_at is None:
             req.first_token_at = now
-            _M_TTFT.observe(now - req.submitted_at)
+            req.decode_mark = (0, now)
+            _M_TTFT.observe(
+                now - req.submitted_at, trace_id=req.trace.trace_id
+            )
         else:
-            _M_INTERTOKEN.observe(now - req.last_token_at)
+            _M_INTERTOKEN.observe(
+                now - req.last_token_at, trace_id=req.trace.trace_id
+            )
         req.last_token_at = now
         self.counters.inc("tokens_generated")
+        if req.decode_mark is None:
+            # revived mid-decode (snapshot restore backfills first_token_at
+            # without a bucket cursor): start a fresh bucket here
+            req.decode_mark = (len(req.tokens) - 1, now)
+        # bucketed decode spans: one per DECODE_SPAN_TOKENS committed tokens
+        # (the remainder flushes at completion below) — per-phase ITL
+        # attribution without a span per token
+        mark_n, mark_t = req.decode_mark
+        if len(req.tokens) - mark_n >= DECODE_SPAN_TOKENS:
+            self._span(
+                "decode", dur_s=now - mark_t, req=req,
+                tokens=len(req.tokens) - mark_n, row=row,
+            )
+            req.decode_mark = (len(req.tokens), now)
         self._mirror_len[row] += 1
         finished = (
             t in self._stop_ids
@@ -3821,22 +3937,33 @@ class PipelineServer:
             # dur == 0 (or an unset started_at) reports 0.0, not inf — a
             # rate measured over no window is no rate
             tok_s = ntok / dur if dur > 0 else 0.0
-            _M_REQUEST.observe(req.finished_at - req.submitted_at)
+            _M_REQUEST.observe(
+                req.finished_at - req.submitted_at,
+                trace_id=req.trace.trace_id,
+            )
             _M_TOK_S.observe(tok_s)
-            if self._trace:
-                span = dict(
-                    id=req.id, tokens=ntok,
-                    queue_wait_s=round(queue_wait, 6),
-                    ttft_s=round(ttft, 6), tok_s=round(tok_s, 2),
+            # flush the final partial decode bucket, then the request span
+            # — the per-server tree node every stage span parents to
+            mark_n, mark_t = req.decode_mark
+            if ntok > mark_n:
+                self._span(
+                    "decode", dur_s=req.finished_at - mark_t, req=req,
+                    tokens=ntok - mark_n, row=row,
                 )
-                if req.tenant is not None:
-                    # ingress traffic: the span stays attributable to its
-                    # tenant (the HTTP response id carries the same req id)
-                    span["tenant"] = req.tenant
-                self._trace.emit(
-                    "request", dur_s=req.finished_at - req.submitted_at,
-                    **span,
-                )
+            span = dict(
+                id=req.id, tokens=ntok,
+                queue_wait_s=round(queue_wait, 6),
+                ttft_s=round(ttft, 6), tok_s=round(tok_s, 2),
+            )
+            if req.tenant is not None:
+                # ingress traffic: the span stays attributable to its
+                # tenant (the HTTP response id carries the same req id)
+                span["tenant"] = req.tenant
+            emit_span(
+                self._trace, "request",
+                dur_s=req.finished_at - req.submitted_at,
+                trace=req.trace, src=self._span_src, **span,
+            )
             logger.info(
                 "complete id=%d tokens=%d duration=%.3fs queue_wait=%.3fs "
                 "tok/s=%.1f",
