@@ -887,24 +887,28 @@ def bench_disagg_serve(on_tpu, cfg, params, jax, jnp):
         for _ in range(n_long)
     ]
 
-    def run(disagg):
+    def run(disagg, async_handoff=True):
         kw = dict(
             data_parallel=2, num_stages=stages, devices=devices,
             capacity=cap, kv_block_size=bs, kv_blocks=blocks,
             prefix_cache="hbm",
         )
         srv = (
-            DisaggServer(cfg, params, roles=["prefill", "decode"], **kw)
+            DisaggServer(
+                cfg, params, roles=["prefill", "decode"],
+                async_handoff=async_handoff, **kw,
+            )
             if disagg else ReplicatedServer(cfg, params, **kw)
         )
         ints = [srv.submit(p, max_new_tokens=max_new) for p in int_prompts]
         # let every interactive stream reach STEADY decode before the
         # long prefills land: first tokens out AND (disagg) hand-offs
-        # settled — the measured window is the interference the split is
-        # supposed to remove, not the one-time hand-off gap (that cost is
-        # visible in tok_s and the unified-vs-disagg TTFT figures)
+        # settled (handoffs_pending counts the async sidecar's in-flight
+        # jobs too) — the measured window is the interference the split
+        # is supposed to remove, not the one-time hand-off gap (that cost
+        # is visible in tok_s and the unified-vs-disagg TTFT figures)
         while not all(r.tokens for r in ints) or (
-            disagg and srv._pending_handoff
+            disagg and srv.handoffs_pending()
         ):
             srv.step()
         longs = [srv.submit(p, max_new_tokens=max_new) for p in long_prompts]
@@ -936,6 +940,10 @@ def bench_disagg_serve(on_tpu, cfg, params, jax, jnp):
     run(False)  # compile the unified programs
     run(True)   # compile the disagg-only variants (radix-hit admissions)
     uni_toks, uni_itl, uni_ttft, uni_tok_s = run(False)
+    # the synchronous-hand-off baseline (ISSUE 14 satellite a): same
+    # disagg run with the stream+adopt back inline on the step thread —
+    # what the async sidecar must not be worse than
+    _, sync_itl, _, _ = run(True, async_handoff=False)
     h0 = DISAGG_HANDOFFS.labels(outcome="ok").value
     dis_toks, dis_itl, dis_ttft, dis_tok_s = run(True)
     handoffs = int(DISAGG_HANDOFFS.labels(outcome="ok").value - h0)
@@ -949,11 +957,42 @@ def bench_disagg_serve(on_tpu, cfg, params, jax, jnp):
         )
     dis_p99 = float(np.percentile(dis_itl, 99)) * 1e3
     uni_p99 = float(np.percentile(uni_itl, 99)) * 1e3
+    dis_p50 = float(np.percentile(dis_itl, 50)) * 1e3
+    uni_p50 = float(np.percentile(uni_itl, 50)) * 1e3
+    sync_p99 = float(np.percentile(sync_itl, 99)) * 1e3
+    # in-band tail gates (ISSUE 14 satellite a): (1) the async sidecar
+    # must be no worse than the synchronous-hand-off baseline it
+    # replaces — on real hardware the sync run carries the whole
+    # device→host→device queue-wait on the step thread, on the CPU
+    # smoke the two are near-equal (tiny copies), so the slack only
+    # trips a sidecar that INTRODUCED a stall; (2) the disagg tail must
+    # not be freeze-shaped vs unified — a p99/p50 ratio tens of times
+    # unified's is what the router-wide synchronous stall looked like
+    # (the decode-side hand-off LANDING work keeps the ratio above
+    # unified's even with the sidecar: adopting a stream is real decode
+    # device work, not a thread stall).
+    dis_ratio = dis_p99 / max(dis_p50, 1e-9)
+    uni_ratio = uni_p99 / max(uni_p50, 1e-9)
+    if dis_p99 > 1.5 * sync_p99 + 5.0:
+        raise RuntimeError(
+            f"async hand-off ITL p99 ({dis_p99:.1f} ms) is worse than "
+            f"the synchronous baseline ({sync_p99:.1f} ms) — the "
+            f"sidecar added a stall instead of removing one"
+        )
+    if dis_ratio > 25 * max(uni_ratio, 1.0):
+        raise RuntimeError(
+            f"disagg ITL tail is freeze-shaped: p99/p50 {dis_ratio:.2f} "
+            f"vs unified {uni_ratio:.2f} — the hand-off stream is back "
+            f"on the step thread?"
+        )
     emit(
         name, dis_p99, "ms", uni_p99 / max(dis_p99, 1e-9),
         unified_itl_p99_ms=round(uni_p99, 2),
-        itl_p50_ms=round(float(np.percentile(dis_itl, 50)) * 1e3, 2),
-        unified_itl_p50_ms=round(float(np.percentile(uni_itl, 50)) * 1e3, 2),
+        sync_handoff_itl_p99_ms=round(sync_p99, 2),
+        itl_p50_ms=round(dis_p50, 2),
+        unified_itl_p50_ms=round(uni_p50, 2),
+        itl_p99_p50_ratio=round(dis_ratio, 2),
+        unified_itl_p99_p50_ratio=round(uni_ratio, 2),
         ttft_p50_ms=round(float(np.percentile(dis_ttft, 50)) * 1e3, 2),
         unified_ttft_p50_ms=round(
             float(np.percentile(uni_ttft, 50)) * 1e3, 2
@@ -1195,6 +1234,219 @@ def bench_paged_kernel_serve(on_tpu, engine):
         attn_bytes_per_step_window=int(window_bytes),
         kv_block_size=block, kv_blocks=kv_blocks,
         token_identical=True,
+    )
+
+
+def bench_prefill_chunk_serve(on_tpu, engine):
+    """Flash-style chunked prefill over the paged arena (ISSUE 14):
+    long-prompt CHUNKED admission at the SAME arena, the Pallas
+    chunked-prefill kernel vs the XLA gather path (``paged_attn`` kernel
+    vs xla — the xla backend gathers each row's full logical window
+    inside the op per layer per chunk, which is the retired
+    ``_gather_window`` traffic shape; the kernel streams only the
+    written frontier's blocks, table-prefetched). Emits kernel tok/s
+    over a prefill-dominated workload (the metric), the XLA figure, and
+    attention-bytes-per-chunk estimates (the kernel's from
+    ``server_prefill_blocks_read_total``; the gather figure is the full
+    window in AND out per chunk — what the pre-ISSUE-14 path moved). On
+    TPU the kernel must beat the gather path outright AND move strictly
+    fewer attention bytes per chunk; the CPU smoke runs the kernel in
+    interpret mode and asserts TOKEN MATCH 1.0 against the XLA oracle
+    (code-path coverage, not a speed claim)."""
+    from llm_sharding_tpu.obs.metrics import PREFILL_BLOCKS_READ
+    from llm_sharding_tpu.parallel.mesh import PIPE_AXIS
+
+    name = (
+        "serve_prefill_chunk_kernel_llama3.2-3b_1stage" if on_tpu
+        else "serve_prefill_chunk_kernel_tiny_cpu"
+    )
+    cfg = engine.cfg
+    if on_tpu:
+        # prefill-dominated: 1024-token prompts admitted in 256-token
+        # chunks into a 2048 window, short decode tails
+        rows, capacity, block, chunk = 4, 2048, 64, 256
+        prompt_len, max_new, n_requests = 1024, 16, 8
+        backends = ("xla", "kernel")
+    else:
+        rows, capacity, block, chunk = 2, 128, 8, 16
+        prompt_len, max_new, n_requests = 56, 4, 4
+        backends = ("xla", "interpret")
+    n_slots = engine.mesh.shape[PIPE_AXIS]
+    kv_blocks = n_slots * rows * capacity // block + 1
+    rng = np.random.default_rng(41)
+    workload = [
+        rng.integers(0, cfg.vocab_size, prompt_len).astype(np.int32)
+        for _ in range(n_requests)
+    ]
+    # bytes per block summed over all layers: K+V, all kv heads, cache
+    # dtype width
+    blk_bytes = (
+        2 * block * cfg.num_key_value_heads * cfg.head_dim_
+        * np.dtype(engine.cache_dtype).itemsize * cfg.num_hidden_layers
+    )
+
+    def run(backend):
+        env_key, prev = "PAGED_FORCE_KERNEL", os.environ.get(
+            "PAGED_FORCE_KERNEL"
+        )
+        if backend == "interpret":  # reached via the env override only
+            os.environ[env_key] = "interpret"
+        try:
+            srv = engine.serve(
+                capacity=capacity, batch_per_slot=rows,
+                kv_block_size=block, kv_blocks=kv_blocks,
+                prefill_chunk=chunk,
+                paged_attn=backend if backend != "interpret" else "auto",
+            )
+        finally:
+            if backend == "interpret":
+                if prev is None:
+                    os.environ.pop(env_key, None)
+                else:
+                    os.environ[env_key] = prev
+        assert srv.attn_impl == backend, (srv.attn_impl, backend)
+        bucket = srv._bucket(prompt_len)
+        blocks0 = PREFILL_BLOCKS_READ.value
+        reqs = [srv.submit(p, max_new_tokens=max_new) for p in workload]
+        t0 = time.perf_counter()
+        while any(not r.done for r in reqs):
+            srv.step()
+        dt = time.perf_counter() - t0
+        toks = [list(r.tokens) for r in reqs]
+        n_chunks = n_requests * (bucket // chunk)
+        blocks_per_chunk = (
+            (PREFILL_BLOCKS_READ.value - blocks0) / max(n_chunks, 1)
+        )
+        n_tok = n_requests * prompt_len + sum(len(t) for t in toks)
+        srv.close()
+        del srv
+        gc.collect()
+        return n_tok / dt, toks, blocks_per_chunk * blk_bytes, bucket
+
+    run(backends[0])  # compile the xla-paged chunk programs
+    xla_tok_s, xla_toks, _, bucket = run(backends[0])
+    run(backends[1])  # compile the kernel programs
+    kern_tok_s, kern_toks, kern_bytes, _ = run(backends[1])
+    if kern_toks != xla_toks:
+        bad = sum(a != b for a, b in zip(kern_toks, xla_toks))
+        raise RuntimeError(
+            f"chunked-prefill kernel diverged from the XLA gather oracle "
+            f"on {bad}/{len(xla_toks)} requests (greedy token match must "
+            f"be 1.0)"
+        )
+    # the retired gather path moved the row's whole mapped window IN
+    # (gather+dequant) and OUT (re-scatter) per chunk
+    gather_bytes = 2 * (capacity // block) * blk_bytes
+    if on_tpu and kern_tok_s <= xla_tok_s:
+        raise RuntimeError(
+            f"chunked-prefill kernel ({kern_tok_s:.1f} tok/s) did not "
+            f"beat the XLA gather path ({xla_tok_s:.1f} tok/s) on the "
+            f"long-prompt chunked workload"
+        )
+    if on_tpu and kern_bytes >= gather_bytes:
+        raise RuntimeError(
+            f"chunked-prefill kernel attn bytes/chunk "
+            f"({int(kern_bytes)}) not below the gather round trip "
+            f"({int(gather_bytes)})"
+        )
+    emit(
+        name, kern_tok_s, "tokens/sec", kern_tok_s / ANCHOR_TOK_S,
+        xla_paged_tok_s=round(xla_tok_s, 2),
+        kernel_backend=backends[1],
+        prompt_len=prompt_len, bucket=bucket, prefill_chunk=chunk,
+        attn_bytes_per_chunk_kernel_est=int(kern_bytes),
+        attn_bytes_per_chunk_gather=int(gather_bytes),
+        kv_block_size=block, kv_blocks=kv_blocks,
+        token_identical=True,
+    )
+
+
+def bench_kv_fp8_quality(on_tpu, engine):
+    """fp8 vs int8 KV quality at equal HBM (ROADMAP 2d): the kv-quant
+    bench's drift harness applied to the DTYPE CHOICE — the same greedy
+    workload on an fp8 arena and an int8 arena of identical byte budget
+    (both 1-byte codes + f32 scales, so identical block counts), each
+    scored by token-match fraction against the exact bf16 run. Emits the
+    fp8 match fraction (the metric; vs_baseline = fp8/int8 match ratio,
+    > 1 means fp8's non-uniform quantization grid preserves more greedy
+    decisions on this workload) alongside ``serve_tok_s_kv8_*``'s 0.95
+    gate — asserted here for BOTH dtypes on the chip workload. Skips
+    cleanly where the backend cannot round-trip float8_e4m3fn."""
+    from llm_sharding_tpu.ops.quant import fp8_kv_supported
+    from llm_sharding_tpu.parallel.mesh import PIPE_AXIS
+
+    name = (
+        "serve_kv_fp8_quality_llama3.2-3b_1stage" if on_tpu
+        else "serve_kv_fp8_quality_tiny_cpu"
+    )
+    if not fp8_kv_supported():
+        emit(
+            name, 0.0, "token_match_frac", 0.0,
+            note="skipped: backend cannot round-trip float8_e4m3fn",
+        )
+        return
+    cfg = engine.cfg
+    if on_tpu:
+        rows, capacity, block, chunk_cycles, depth = 16, 320, 8, 8, 2
+        prompt_len, short_new, long_new, long_every = 32, 32, 192, 6
+        n_requests = 48
+    else:
+        rows, capacity, block, chunk_cycles, depth = 2, 64, 16, 2, 1
+        prompt_len, short_new, long_new, long_every = 8, 8, 32, 4
+        n_requests = 8
+    n_slots = engine.mesh.shape[PIPE_AXIS]
+    kv_blocks = n_slots * rows * capacity // block + 1
+    rng = np.random.default_rng(47)
+    workload = [
+        (
+            rng.integers(0, cfg.vocab_size, prompt_len).astype(np.int32),
+            long_new if i % long_every == long_every - 1 else short_new,
+        )
+        for i in range(n_requests)
+    ]
+
+    def run(kv_dtype):
+        srv = engine.serve(
+            capacity=capacity, batch_per_slot=rows,
+            chunk_cycles=chunk_cycles, pipeline_depth=depth,
+            kv_block_size=block, kv_blocks=kv_blocks,
+            kv_dtype=kv_dtype,
+        )
+        reqs = [srv.submit(p, max_new_tokens=n) for p, n in workload]
+        while any(not r.done for r in reqs):
+            srv.step()
+        toks = [list(r.tokens) for r in reqs]
+        srv.close()
+        del srv
+        gc.collect()
+        return toks
+
+    def match_frac(toks, ref):
+        per = [
+            sum(a == b for a, b in zip(d, p)) / max(len(p), 1)
+            for d, p in zip(toks, ref)
+        ]
+        return sum(per) / len(per)
+
+    run("bf16")  # compile at this shape
+    ref = run("bf16")
+    int8_m = match_frac(run("int8"), ref)
+    fp8_m = match_frac(run("fp8"), ref)
+    if on_tpu and (fp8_m < 0.95 or int8_m < 0.95):
+        # the same drift-tolerance gate as serve_tok_s_kv8_*, applied to
+        # both 1-byte dtypes — a dtype recommendation below it is noise
+        raise RuntimeError(
+            f"1-byte KV greedy token-match below the 0.95 gate "
+            f"(fp8 {fp8_m:.3f}, int8 {int8_m:.3f})"
+        )
+    emit(
+        name, fp8_m, "token_match_frac",
+        fp8_m / max(int8_m, 1e-9),
+        int8_match_frac=round(int8_m, 4),
+        fp8_match_frac=round(fp8_m, 4),
+        kv_block_size=block, kv_blocks=kv_blocks,
+        equal_hbm=True,  # identical block counts: both dtypes store
+        # 1-byte codes + f32 per-block-per-head scales
     )
 
 
@@ -1734,6 +1986,14 @@ def main():
         "serve_tok_s_paged_kernel_llama3.2-3b_1stage" if on_tpu
         else "serve_tok_s_paged_kernel_tiny_cpu"
     )
+    nprefchunk = (
+        "serve_prefill_chunk_kernel_llama3.2-3b_1stage" if on_tpu
+        else "serve_prefill_chunk_kernel_tiny_cpu"
+    )
+    nfp8q = (
+        "serve_kv_fp8_quality_llama3.2-3b_1stage" if on_tpu
+        else "serve_kv_fp8_quality_tiny_cpu"
+    )
     nradix = (
         "serve_tok_s_radix_llama3.2-3b_1stage" if on_tpu
         else "serve_tok_s_radix_tiny_cpu"
@@ -1821,6 +2081,18 @@ def main():
                 bench_paged_kernel_serve(on_tpu, serve_engine)
             except Exception as e:  # noqa: BLE001
                 emit_error(npagedk, "tokens/sec", e)
+        # chunked-prefill kernel (long-prompt admission, kernel vs
+        # gather at the same arena) reuses the same engine
+        if serve_engine is None:
+            emit_error(nprefchunk, "tokens/sec",
+                       "not attempted: serve engine unavailable")
+        elif remaining() < 240:
+            emit_skip(nprefchunk, "tokens/sec", 240)
+        else:
+            try:
+                bench_prefill_chunk_serve(on_tpu, serve_engine)
+            except Exception as e:  # noqa: BLE001
+                emit_error(nprefchunk, "tokens/sec", e)
         # automatic prefix caching (multi-turn chat warm-vs-cold) reuses
         # the same engine
         if serve_engine is None:
@@ -1846,6 +2118,18 @@ def main():
                 bench_kv_quant_serve(on_tpu, serve_engine)
             except Exception as e:  # noqa: BLE001
                 emit_error(nkv8, "tokens/sec", e)
+        # fp8 vs int8 KV quality at equal HBM (ROADMAP 2d) reuses the
+        # same engine
+        if serve_engine is None:
+            emit_error(nfp8q, "token_match_frac",
+                       "not attempted: serve engine unavailable")
+        elif remaining() < 180:
+            emit_skip(nfp8q, "token_match_frac", 180)
+        else:
+            try:
+                bench_kv_fp8_quality(on_tpu, serve_engine)
+            except Exception as e:  # noqa: BLE001
+                emit_error(nfp8q, "token_match_frac", e)
         # fault-injection serve (robustness overhead) reuses the serve
         # engine before it is torn down
         if serve_engine is None:

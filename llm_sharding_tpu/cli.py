@@ -1328,7 +1328,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument(
         "--prefill-chunk", type=int, default=None, dest="prefill_chunk",
         help="prefill prompts longer than this in bounded chunks so live "
-        "streams keep producing during admission (power of two)",
+        "streams keep producing during admission (power of two). Paged "
+        "chunks attend the pooled arena in place (--paged-attn governs "
+        "the kernel) and COMPOSE with the radix prefix cache: a cached "
+        "hit's leftover suffix chunk-prefills from its offset instead "
+        "of falling back cold",
     )
     s.add_argument(
         "--speculate", type=int, default=0,
@@ -1402,14 +1406,18 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument(
         "--paged-attn", choices=("auto", "kernel", "xla"), default="auto",
         dest="paged_attn",
-        help="paged decode attention implementation (with --kv-block-size/"
-        "--kv-blocks): auto = Pallas kernel on TPU for Mosaic-eligible "
-        "shapes (head_dim %% 128 == 0, block size a sublane multiple), "
-        "exact XLA gather elsewhere; kernel = require the Pallas kernel "
-        "(fails at startup if ineligible); xla = force the gather "
-        "fallback. The kernel streams only each row's mapped blocks per "
-        "decode step, so attention HBM traffic scales with blocks in "
-        "flight, not logical context",
+        help="paged attention implementation for BOTH decode steps and "
+        "chunked prefill (with --kv-block-size/--kv-blocks): auto = "
+        "Pallas kernels on TPU for Mosaic-eligible shapes (head_dim %% "
+        "128 == 0, block size a sublane multiple), exact XLA gather "
+        "elsewhere; kernel = require the Pallas kernels (fails at "
+        "startup if ineligible); xla = force the gather fallback. The "
+        "decode kernel streams only each row's mapped blocks per step "
+        "(multiple per grid step, double-buffered — blocks_per_step "
+        "auto-tunes from the table width); the chunked-prefill kernel "
+        "(--prefill-chunk) attends the arena in place up to each row's "
+        "written frontier, so admission never round-trips a gathered "
+        "window through HBM",
     )
     s.add_argument(
         "--prefix-cache", choices=("off", "hbm", "host"), default="off",

@@ -186,13 +186,20 @@ def forward_layers_paged(
     backend: str = "auto",
     k_scale: Optional[jnp.ndarray] = None,  # [L, NB, Nkv] (quantized)
     v_scale: Optional[jnp.ndarray] = None,
+    prefill: bool = False,  # static: chunked-prefill traversal — attend
+    #   via the query-tiled paged_prefill kernel (see llama counterpart)
+    nlive: Optional[jnp.ndarray] = None,  # [B] prefill traffic clamp
 ):
     """Paged serve-decode counterpart of ``forward_layers`` (see
     ``models/llama.forward_layers_paged`` — same contract: fresh KV lands
     via ``write_block_kv`` (quantizing at insert when the arena carries
     scales), attention streams the table's blocks (dequant fused), kpos
-    bookkeeping stays with the caller; returns scale arenas too)."""
-    from ..ops.paged_attention import paged_attention, write_block_kv
+    bookkeeping stays with the caller; returns scale arenas too).
+    ``prefill`` switches the attention dispatch to ``paged_prefill``
+    for chunk-shaped queries."""
+    from ..ops.paged_attention import (
+        paged_attention, paged_prefill, write_block_kv,
+    )
     from .stack import scan_layers_paged
 
     wv = write_valid if isinstance(write_valid, bool) else jnp.asarray(
@@ -214,6 +221,12 @@ def forward_layers_paged(
                     k_scale=ks_l, v_scale=vs_l,
                 )
                 k_a, v_a = out["kv"][0], out["kv"][1]
+            if prefill:
+                return paged_prefill(
+                    q, k_a, v_a, block_table, positions, kv_positions,
+                    backend=backend, k_scale=out["kv"][2],
+                    v_scale=out["kv"][3], nlive=nlive,
+                )
             return paged_attention(
                 q, k_a, v_a, block_table, positions, kv_positions,
                 backend=backend, k_scale=out["kv"][2],

@@ -223,14 +223,24 @@ def paged_decoder_layer(
     backend: str = "auto",
     k_scale: Optional[jnp.ndarray] = None,  # [NB, Nkv] — quantized arena
     v_scale: Optional[jnp.ndarray] = None,
+    prefill: bool = False,  # static: chunk-shaped queries — attend via
+    #   the query-tiled paged_prefill kernel instead of the decode one
+    nlive: Optional[jnp.ndarray] = None,  # [B] prefill traffic clamp
 ):
     """Decode-path layer over the pooled arena: the step's fresh KV lands
     via a block-indexed scatter and attention streams exactly the blocks
     the table names (``ops/paged_attention``) — the logical window is
     never materialized. A quantized arena (``k_scale``/``v_scale``)
     quantizes the fresh entries at insert and dequantizes inside the
-    attention op (fused into the kernel's per-block DMA loop)."""
-    from ..ops.paged_attention import paged_attention, write_block_kv
+    attention op (fused into the kernel's per-block DMA loop). With
+    ``prefill`` the attention dispatch is ``paged_prefill`` — the
+    flash-style chunked-prefill kernel whose query axis is the whole
+    chunk (``nlive`` bounds its KV streaming to each row's written
+    frontier); write-then-attend order is identical, so intra-chunk
+    causality falls out of the position masking either way."""
+    from ..ops.paged_attention import (
+        paged_attention, paged_prefill, write_block_kv,
+    )
 
     out = {}
 
@@ -247,6 +257,12 @@ def paged_decoder_layer(
                 valid=write_valid & valid, k_scale=k_scale, v_scale=v_scale,
             )
             out["kv"] = (k_a, v_a, ks, vs)
+        if prefill:
+            return paged_prefill(
+                q, k_a, v_a, block_table, positions, kv_positions,
+                backend=backend, k_scale=out["kv"][2],
+                v_scale=out["kv"][3], nlive=nlive,
+            )
         return paged_attention(
             q, k_a, v_a, block_table, positions, kv_positions,
             backend=backend, k_scale=out["kv"][2], v_scale=out["kv"][3],
@@ -272,6 +288,9 @@ def forward_layers_paged(
     backend: str = "auto",
     k_scale: Optional[jnp.ndarray] = None,  # [L, NB, Nkv] (quantized)
     v_scale: Optional[jnp.ndarray] = None,
+    prefill: bool = False,  # static: chunked-prefill traversal (see
+    #   paged_decoder_layer) — queries are a whole prompt chunk
+    nlive: Optional[jnp.ndarray] = None,  # [B] prefill traffic clamp
 ):
     """Paged counterpart of ``forward_layers`` for the serve decode path:
     scans the layer stack over the pooled arena (``stack.scan_layers_paged``)
@@ -289,7 +308,7 @@ def forward_layers_paged(
         return paged_decoder_layer(
             cfg, p, valid, h, k_l, v_l, block_table, cols, cos, sin,
             positions, kv_positions, wv, tp_axis, backend,
-            k_scale=ks_l, v_scale=vs_l,
+            k_scale=ks_l, v_scale=vs_l, prefill=prefill, nlive=nlive,
         )
 
     return scan_layers_paged(

@@ -568,6 +568,44 @@ ATTN_BLOCKS_READ = REGISTRY.counter(
     "capacity slots per row regardless of length",
 )
 
+#: Chunked-prefill implementations a dispatch can take: ``kernel`` = the
+#: Pallas flash-style chunked-prefill kernel over the arena (interpret
+#: mode counts here — it is the same code path emulated off-TPU),
+#: ``xla`` = the exact in-op gather fallback over the arena, ``gather``
+#: = the dense full-window slice path (non-paged serving).
+PREFILL_PATHS = ("kernel", "xla", "gather")
+PREFILL_PATH = REGISTRY.gauge(
+    "server_prefill_path",
+    "Chunked-prefill attention path of the most recent chunk dispatch, "
+    "one-hot over {kernel, xla, gather}: kernel = the Pallas "
+    "chunked-prefill kernel streaming table-named arena blocks "
+    "(interpret-emulated off-TPU counts as kernel), xla = the arena "
+    "gather inside the op (exact fallback), gather = dense (non-paged) "
+    "full-window prefill",
+    labels=("path",),
+)
+PREFILL_BLOCKS_READ = REGISTRY.counter(
+    "server_prefill_blocks_read_total",
+    "KV arena blocks attended by chunked-prefill dispatches, summed over "
+    "admitting rows per chunk (host-side: ceil((prefix_offset + "
+    "chunk_end) / block_size) per row — the written frontier each "
+    "chunk's queries attend). Multiply by block bytes x layers for a "
+    "prefill-attention-HBM estimate; the retired gather path moved the "
+    "row's WHOLE mapped window in AND out per chunk on top of this",
+)
+
+
+def set_prefill_path(path: str) -> None:
+    """One-hot flip of ``server_prefill_path`` (the chunk-dispatch-site
+    analogue of the ``server_attn_backend`` sweep)."""
+    if path not in PREFILL_PATHS:
+        raise ValueError(
+            f"unknown prefill path {path!r}; expected one of "
+            f"{PREFILL_PATHS}"
+        )
+    for p in PREFILL_PATHS:
+        PREFILL_PATH.labels(path=p).set(1.0 if p == path else 0.0)
+
 
 # -- replica supervision (runtime/replicated.py) ----------------------------
 # Defined here like the KV gauges: the failover/migration counters and the

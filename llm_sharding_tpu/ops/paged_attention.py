@@ -15,20 +15,29 @@ attention over that layout:
   CPU mesh (and the serve programs in ``parallel/serve.py``, which gather
   at the shard_map boundary) execute; numerics are identical to dense
   attention over the same positions by construction.
-- ``paged_attention_tpu``: a Pallas kernel that never materializes the
-  gathered window in HBM. The block table rides as a SCALAR-PREFETCH
+- ``paged_attention_tpu``: a Pallas DECODE kernel that never materializes
+  the gathered window in HBM. The block table rides as a SCALAR-PREFETCH
   operand (``pltpu.PrefetchScalarGridSpec``), so each grid step's
-  ``BlockSpec`` index map picks the arena block to DMA directly from the
-  table — KV traffic per step is ``T × block_size`` slots (the row's
-  mapped window), not the dense capacity, and blocks stream through VMEM
-  with online-softmax accumulation exactly like ``ops/flash_attention``.
-- ``paged_attention``: backend dispatch (pallas on TPU for MXU-aligned
-  head_dim, XLA elsewhere). Same masking contract everywhere:
-  ``kv_pos <= q_pos``, sentinel = masked — so never-written block tails
-  drop out for free, and trash-mapped entries (block 0) additionally
-  gather/stream as ZEROS (both paths): the shared trash block accumulates
-  parked rows' garbage, and a non-finite garbage value would otherwise
-  turn the masked probability-0 positions into ``0 × Inf = NaN``.
+  ``BlockSpec`` index maps pick the arena blocks to DMA directly from the
+  table — ``blocks_per_step`` of them per sequential step
+  (``auto_blocks_per_step``; independent refs the compiler overlaps and
+  double-buffers) — and blocks stream through VMEM with online-softmax
+  accumulation exactly like ``ops/flash_attention``.
+- ``paged_prefill_tpu``: the CHUNKED-PREFILL kernel — same table-driven
+  KV streaming, but the query axis is a whole prompt chunk, GQA-folded
+  and tiled at ``BLOCK_Q_PREFILL`` like the flash kernel, with an
+  ``nlive`` per-row clamp that redirects blocks past the written
+  frontier to the (DMA-elided) trash block. This is what lets
+  ``serve_prefill_chunk`` attend the arena in place instead of
+  round-tripping a gathered O(window) copy per chunk.
+- ``paged_attention`` / ``paged_prefill``: backend dispatch (pallas on
+  TPU for MXU-aligned head_dim, XLA elsewhere). Same masking contract
+  everywhere: ``kv_pos <= q_pos``, sentinel = masked — so never-written
+  block tails drop out for free, and trash-mapped entries (block 0)
+  additionally gather/stream as ZEROS (both paths): the shared trash
+  block accumulates parked rows' garbage, and a non-finite garbage value
+  would otherwise turn the masked probability-0 positions into
+  ``0 × Inf = NaN``.
 
 The retired ``bucketed_decode_attention`` (the decode-window ``lax.switch``
 whose branch copies made it SLOWER than full-capacity attention — see the
@@ -86,6 +95,22 @@ def forced_backend() -> str | None:
             f"interpret or 1"
         )
     return raw
+
+
+def auto_blocks_per_step(t_blocks: int, block_size: int) -> int:
+    """Auto-selected KV blocks batched per sequential grid step of the
+    Pallas kernels: the largest of 8/4/2/1 that divides the table width
+    and keeps the batched score tile at or under 512 lanes (Mosaic's
+    sweet spot; per-step K+V VMEM stays ≤ 256 KB at D=128 bf16). At
+    small serving block sizes one arena block is a skinny (BS, D) tile
+    that underfeeds the MXU and pays one DMA turnaround per block;
+    batching ``bps`` blocks per step gives the compiler ``bps``
+    independent in-flight DMAs (double-buffered across steps) and a
+    (GS, bps·BS) score tile per dot."""
+    for bps in (8, 4, 2, 1):
+        if t_blocks % bps == 0 and bps * block_size <= 512:
+            return bps
+    return 1
 
 
 def kernel_sublane(cache_dtype) -> int:
@@ -256,60 +281,16 @@ def paged_attention_xla(
     return cached_attention(q, k, v, q_positions, kv_positions, scale)
 
 
-def _paged_kernel(
-    tbl_ref,  # scalar-prefetch [B, T] (read by the index maps + trash gate)
-    q_ref,  # [1, 1, GS, D]
-    k_ref,  # [1, 1, BS, D] — the arena block the index map picked
-    v_ref,  # [1, 1, BS, D]
-    *rest,  # quantized: ks_ref, vs_ref (1,1) SMEM per-block-per-head
-    #   scales, then the common refs; bf16: the common refs directly —
-    #   qpos [1, GS, 1], kvpos [1, 1, BS], out [1, 1, GS, D],
-    #   scratch acc [GS, D] f32, m [GS, 128] f32, l [GS, 128] f32
-    scale,
-    t_blocks,
-    quantized=False,
-):
-    if quantized:
-        ks_ref, vs_ref = rest[0], rest[1]
-        rest = rest[2:]
-    qpos_ref, kvpos_ref, out_ref, acc_ref, m_ref, l_ref = rest
-    t = pl.program_id(2)
-
-    @pl.when(t == 0)
-    def _init():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-
-    q = q_ref[0, 0]  # [GS, D]
-    k_blk, v_blk = k_ref[0, 0], v_ref[0, 0]  # [BS, D]
-    if quantized:
-        # THE fused dequant: the block streamed into VMEM as 1-byte codes
-        # (half/quarter the DMA bytes of bf16) and dequantizes here against
-        # its per-(block, head) scale — the bf16 window never exists in
-        # HBM. Dequant target is the query dtype, matching the XLA gather
-        # path bit for bit.
-        k_blk = (k_blk.astype(jnp.float32) * ks_ref[0, 0]).astype(q.dtype)
-        v_blk = (v_blk.astype(jnp.float32) * vs_ref[0, 0]).astype(q.dtype)
-    # trash blocks (table entry 0) stream as zeros: their garbage contents
-    # are position-masked to probability 0 below, but non-finite garbage
-    # would still NaN the masked positions (0 x Inf) through the score and
-    # PV products. where(), not multiply — Inf * 0 is itself NaN.
-    live = tbl_ref[pl.program_id(0), pl.program_id(2)] != 0
-    k = jnp.where(live, k_blk, jnp.zeros_like(k_blk))  # [BS, D]
-    v = jnp.where(live, v_blk, jnp.zeros_like(v_blk))
-
+def _online_update(q, k, v, mask, scale, acc_ref, m_ref, l_ref):
+    """One flash-attention recurrence step over a streamed KV tile: score
+    the tile, fold it into the (acc, m, l) running softmax scratch. Shared
+    by the decode kernel and the chunked-prefill kernel — the masking and
+    accumulation contract is ``ops/flash_attention._flash_kernel``'s
+    (NEG_INF masking; an all-masked tile's garbage is wiped by the first
+    real tile's correction factor)."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale  # [GS, BS] f32
-
-    # same layout contract as ops/flash_attention._flash_kernel: qpos rides
-    # sublane-major, kvpos lane-major, so the mask broadcast maps onto the
-    # score tile with no Mosaic relayout. Sentinel positions (trash-mapped
-    # slots, never-written block tails) mask out here; an all-masked block
-    # leaves a NEG_INF running max that the first real block's correction
-    # factor wipes (see the flash kernel's masking note).
-    mask = kvpos_ref[0] <= qpos_ref[0]  # [GS, BS]
     s = jnp.where(mask, s, NEG_INF)
 
     m_prev = m_ref[:, :1]
@@ -327,7 +308,77 @@ def _paged_kernel(
     m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
     l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    @pl.when(t == t_blocks - 1)
+
+def _paged_kernel(
+    tbl_ref,  # scalar-prefetch [B, T] (read by the index maps + trash gate)
+    q_ref,  # [1, 1, GS, D]
+    *rest,  # bps k refs [1, 1, BS, D] (the arena blocks the index maps
+    #   picked), bps v refs; quantized: bps ks refs + bps vs refs ((1, 1)
+    #   SMEM per-block-per-head scales); then the common refs — qpos
+    #   [1, GS, 1], kvpos [1, 1, bps*BS], out [1, 1, GS, D], scratch
+    #   acc [GS, D] f32, m [GS, 128] f32, l [GS, 128] f32
+    scale,
+    t_steps,
+    bps,
+    quantized=False,
+):
+    k_refs, rest = rest[:bps], rest[bps:]
+    v_refs, rest = rest[:bps], rest[bps:]
+    if quantized:
+        ks_refs, rest = rest[:bps], rest[bps:]
+        vs_refs, rest = rest[:bps], rest[bps:]
+    qpos_ref, kvpos_ref, out_ref, acc_ref, m_ref, l_ref = rest
+    t = pl.program_id(2)
+
+    @pl.when(t == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0, 0]  # [GS, D]
+    BS = k_refs[0].shape[2]
+    # bps arena blocks stream per sequential step (auto_blocks_per_step):
+    # each sub-block is its own DMA'd ref, so the compiler overlaps the
+    # bps fetches and double-buffers them across steps; the recurrence
+    # folds them in table order (associative up to fp reassociation —
+    # identical to bps=1 up to the usual flash rounding)
+    for j in range(bps):
+        k_blk, v_blk = k_refs[j][0, 0], v_refs[j][0, 0]  # [BS, D]
+        if quantized:
+            # THE fused dequant: the block streamed into VMEM as 1-byte
+            # codes (half/quarter the DMA bytes of bf16) and dequantizes
+            # here against its per-(block, head) scale — the bf16 window
+            # never exists in HBM. Dequant target is the query dtype,
+            # matching the XLA gather path bit for bit.
+            k_blk = (
+                k_blk.astype(jnp.float32) * ks_refs[j][0, 0]
+            ).astype(q.dtype)
+            v_blk = (
+                v_blk.astype(jnp.float32) * vs_refs[j][0, 0]
+            ).astype(q.dtype)
+        # trash blocks (table entry 0) stream as zeros: their garbage
+        # contents are position-masked to probability 0 below, but
+        # non-finite garbage would still NaN the masked positions
+        # (0 x Inf) through the score and PV products. where(), not
+        # multiply — Inf * 0 is itself NaN.
+        live = tbl_ref[pl.program_id(0), t * bps + j] != 0
+        k = jnp.where(live, k_blk, jnp.zeros_like(k_blk))  # [BS, D]
+        v = jnp.where(live, v_blk, jnp.zeros_like(v_blk))
+
+        # same layout contract as ops/flash_attention._flash_kernel: qpos
+        # rides sublane-major, kvpos lane-major, so the mask broadcast
+        # maps onto the score tile with no Mosaic relayout. Sentinel
+        # positions (trash-mapped slots, never-written block tails) mask
+        # out here; an all-masked block leaves a NEG_INF running max that
+        # the first real block's correction factor wipes (see the flash
+        # kernel's masking note).
+        mask = (
+            kvpos_ref[0, :, j * BS:(j + 1) * BS] <= qpos_ref[0]
+        )  # [GS, BS]
+        _online_update(q, k, v, mask, scale, acc_ref, m_ref, l_ref)
+
+    @pl.when(t == t_steps - 1)
     def _finish():
         l = l_ref[:, :1]
         out_ref[0, 0] = (acc_ref[:] / jnp.maximum(l, 1e-30)).astype(
@@ -335,7 +386,9 @@ def _paged_kernel(
         )
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("scale", "interpret", "blocks_per_step")
+)
 def paged_attention_tpu(
     q: jnp.ndarray,  # [B, S, Nh, D]
     k_arena: jnp.ndarray,  # [NB, BS, Nkv, D]
@@ -347,19 +400,25 @@ def paged_attention_tpu(
     interpret: bool = False,
     k_scale: jnp.ndarray = None,  # [NB, Nkv] — quantized arenas only
     v_scale: jnp.ndarray = None,
+    blocks_per_step: int | None = None,  # static; None = auto-selected
 ) -> jnp.ndarray:
-    """Pallas paged attention: grid ``(B, Nkv, T)``, the T axis sequential.
-    Each step DMAs ONE arena block, chosen by the scalar-prefetched block
-    table — the gathered window never exists in HBM. GQA-folded like the
+    """Pallas paged attention: grid ``(B, Nkv, T/bps)``, the last axis
+    sequential. Each step DMAs ``bps`` arena blocks (``blocks_per_step``,
+    auto-selected from the table width by ``auto_blocks_per_step`` when
+    None), each chosen by the scalar-prefetched block table — the gathered
+    window never exists in HBM, and the ``bps`` per-step fetches are
+    independent refs the compiler overlaps and double-buffers across
+    steps (one skinny (BS, D) DMA per step left the MXU waiting on the
+    fetch turnaround at small serving block sizes). GQA-folded like the
     flash kernel (each KV block streams once per KV head, not per query
     head). Decode-shaped: GS = G·S query rows stay in one tile, so keep
     ``G·S`` small (serving decode is S=1).
 
-    VMEM per step is one (BS, D) K block + V block + the (GS, BS) score
-    tile + (GS, D)+2·(GS, 128) scratch — tiny at serving block sizes (e.g.
-    BS=64, D=128: ~100 KB). Real-TPU use wants D a lane multiple (128) and
-    BS a sublane multiple for the cache dtype; ``paged_attention`` gates on
-    that and interpret-mode covers the rest.
+    VMEM per step is bps (BS, D) K blocks + V blocks + the (GS, bps·BS)
+    score tiles + (GS, D)+2·(GS, 128) scratch — ≤ ~400 KB at the auto
+    cap (bps·BS ≤ 512, D=128). Real-TPU use wants D a lane multiple
+    (128) and BS a sublane multiple for the cache dtype;
+    ``paged_attention`` gates on that and interpret-mode covers the rest.
 
     Quantized arenas (``k_scale``/``v_scale``): the per-block DMA moves
     1-byte codes — HALF (int8 vs bf16) the per-step attention HBM traffic
@@ -380,6 +439,11 @@ def paged_attention_tpu(
             f"kv_positions must be [B, T*BS]={B, T * BS}, got "
             f"{kv_positions.shape}"
         )
+    bps = blocks_per_step or auto_blocks_per_step(T, BS)
+    if T % bps != 0:
+        raise ValueError(
+            f"blocks_per_step={bps} does not divide the table width {T}"
+        )
 
     # GQA fold (the reshape contract of cached_attention: head h = k*G + g)
     qh = jnp.transpose(q, (0, 2, 1, 3)).reshape(B, Nkv, GS, D)
@@ -388,35 +452,45 @@ def paged_attention_tpu(
     vh = jnp.transpose(v_arena, (0, 2, 1, 3))
     kp = kv_positions[:, None, :]  # [B, 1, T*BS]
 
-    # the arena-block specs: each grid cell streams the block the
-    # scalar-prefetched table names; quantized runs add the block's
-    # per-head scale as a (1, 1) SMEM scalar picked by the same indices
-    block_spec = pl.BlockSpec(
-        (1, 1, BS, D), lambda b, k, t, tbl: (tbl[b, t], k, 0, 0)
-    )
-    scale_spec = pl.BlockSpec(
-        (1, 1), lambda b, k, t, tbl: (tbl[b, t], k),
-        memory_space=pltpu.SMEM,
-    )
+    # the arena-block specs: each grid cell streams the bps blocks the
+    # scalar-prefetched table names (one ref per sub-block — independent
+    # DMAs); quantized runs add each block's per-head scale as a (1, 1)
+    # SMEM scalar picked by the same indices
+    def block_spec(j):
+        return pl.BlockSpec(
+            (1, 1, BS, D),
+            lambda b, k, t, tbl, j=j: (tbl[b, t * bps + j], k, 0, 0),
+        )
+
+    def scale_spec(j):
+        return pl.BlockSpec(
+            (1, 1), lambda b, k, t, tbl, j=j: (tbl[b, t * bps + j], k),
+            memory_space=pltpu.SMEM,
+        )
+
     in_specs = [
         pl.BlockSpec((1, 1, GS, D), lambda b, k, t, tbl: (b, k, 0, 0)),
-        block_spec,
-        block_spec,
+        *[block_spec(j) for j in range(bps)],
+        *[block_spec(j) for j in range(bps)],
     ]
-    operands = [block_table, qh, kh, vh]
+    operands = [block_table, qh, *([kh] * bps), *([vh] * bps)]
     if quantized:
-        in_specs += [scale_spec, scale_spec]
-        operands += [
-            k_scale.astype(jnp.float32), v_scale.astype(jnp.float32),
-        ]
+        in_specs += (
+            [scale_spec(j) for j in range(bps)]
+            + [scale_spec(j) for j in range(bps)]
+        )
+        operands += (
+            [k_scale.astype(jnp.float32)] * bps
+            + [v_scale.astype(jnp.float32)] * bps
+        )
     in_specs += [
         pl.BlockSpec((1, GS, 1), lambda b, k, t, tbl: (b, 0, 0)),
-        pl.BlockSpec((1, 1, BS), lambda b, k, t, tbl: (b, 0, t)),
+        pl.BlockSpec((1, 1, bps * BS), lambda b, k, t, tbl: (b, 0, t)),
     ]
     operands += [qp, kp]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(B, Nkv, T),
+        grid=(B, Nkv, T // bps),
         in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, GS, D), lambda b, k, t, tbl: (b, k, 0, 0)
@@ -429,7 +503,8 @@ def paged_attention_tpu(
     )
     out = pl.pallas_call(
         functools.partial(
-            _paged_kernel, scale=scale, t_blocks=T, quantized=quantized
+            _paged_kernel, scale=scale, t_steps=T // bps, bps=bps,
+            quantized=quantized,
         ),
         out_shape=jax.ShapeDtypeStruct((B, Nkv, GS, D), q.dtype),
         grid_spec=grid_spec,
@@ -440,6 +515,311 @@ def paged_attention_tpu(
     )(*operands)
     out = out.reshape(B, Nkv, G, S, D)
     return jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(B, S, Nh, D)
+
+
+#: Query-row tile of the chunked-prefill kernel (G·Sc folded rows per
+#: grid cell). 256 keeps the f32 score tile at (256, bps·BS ≤ 512) —
+#: ≤ 512 KB — and the whole per-step VMEM well under the flash kernel's
+#: audited budget; chunks smaller than this run as one (padded) tile.
+BLOCK_Q_PREFILL = 256
+
+
+def _paged_prefill_kernel(
+    tbl_ref,  # scalar-prefetch [B, T]
+    nlive_ref,  # scalar-prefetch [B] — live (attendable) blocks per row
+    q_ref,  # [1, 1, BQ, D]
+    *rest,  # bps k refs [1, 1, BS, D], bps v refs; quantized: + bps ks
+    #   refs and bps vs refs ((1, 1) SMEM); then qpos [1, BQ, 1], kvpos
+    #   [1, 1, bps*BS], out [1, 1, BQ, D], scratch acc/m/l
+    scale,
+    t_steps,
+    bps,
+    quantized=False,
+):
+    k_refs, rest = rest[:bps], rest[bps:]
+    v_refs, rest = rest[:bps], rest[bps:]
+    if quantized:
+        ks_refs, rest = rest[:bps], rest[bps:]
+        vs_refs, rest = rest[:bps], rest[bps:]
+    qpos_ref, kvpos_ref, out_ref, acc_ref, m_ref, l_ref = rest
+    b = pl.program_id(0)
+    t = pl.program_id(3)
+
+    @pl.when(t == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0, 0]  # [BQ, D]
+    BS = k_refs[0].shape[2]
+    for j in range(bps):
+        k_blk, v_blk = k_refs[j][0, 0], v_refs[j][0, 0]  # [BS, D]
+        if quantized:
+            # fused dequant, same contract as the decode kernel: codes
+            # stream, the bf16 window never exists in HBM
+            k_blk = (
+                k_blk.astype(jnp.float32) * ks_refs[j][0, 0]
+            ).astype(q.dtype)
+            v_blk = (
+                v_blk.astype(jnp.float32) * vs_refs[j][0, 0]
+            ).astype(q.dtype)
+        # live gate: trash blocks (table entry 0) AND blocks past the
+        # row's written frontier (the index maps redirected their DMA to
+        # block 0 — see paged_prefill_tpu) stream as zeros. Their
+        # positions are sentinel-masked below anyway; zeroing closes the
+        # 0 × Inf = NaN channel of the shared trash block's garbage.
+        idx = t * bps + j
+        live = (tbl_ref[b, idx] != 0) & (idx < nlive_ref[b])
+        k = jnp.where(live, k_blk, jnp.zeros_like(k_blk))
+        v = jnp.where(live, v_blk, jnp.zeros_like(v_blk))
+        # causal masking WITHIN the chunk falls out of the position
+        # compare: the chunk's own entries were scattered into the arena
+        # (with their kv positions) before this kernel runs, so a query
+        # at position p attends exactly the prefix ≤ p — earlier chunks,
+        # the radix prefix, and the chunk's own earlier tokens.
+        mask = (
+            kvpos_ref[0, :, j * BS:(j + 1) * BS] <= qpos_ref[0]
+        )  # [BQ, BS]
+        _online_update(q, k, v, mask, scale, acc_ref, m_ref, l_ref)
+
+    @pl.when(t == t_steps - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        out_ref[0, 0] = (acc_ref[:] / jnp.maximum(l, 1e-30)).astype(
+            out_ref.dtype
+        )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "interpret", "blocks_per_step")
+)
+def paged_prefill_tpu(
+    q: jnp.ndarray,  # [B, S, Nh, D] — S = the chunk length (many rows)
+    k_arena: jnp.ndarray,  # [NB, BS, Nkv, D]
+    v_arena: jnp.ndarray,
+    block_table: jnp.ndarray,  # [B, T] int32
+    q_positions: jnp.ndarray,  # [B, S]
+    kv_positions: jnp.ndarray,  # [B, T*BS]
+    scale: float | None = None,
+    interpret: bool = False,
+    k_scale: jnp.ndarray = None,  # [NB, Nkv] — quantized arenas only
+    v_scale: jnp.ndarray = None,
+    nlive: jnp.ndarray = None,  # [B] int32 — blocks covering each row's
+    #   written frontier (prefix + chunks so far); None = the full table
+    blocks_per_step: int | None = None,  # static; None = auto-selected
+) -> jnp.ndarray:
+    """Flash-style CHUNKED-PREFILL attention over the paged arena: the
+    query axis is a whole prompt chunk (folded with the GQA groups and
+    tiled at ``BLOCK_Q_PREFILL`` like ``ops/flash_attention``), the KV
+    axis streams the arena blocks the scalar-prefetched table names
+    (``blocks_per_step`` per sequential step, like the decode kernel) —
+    the gathered [B, W, Nkv, D] window of the retired
+    ``_gather_window`` round trip never exists in HBM, and nothing is
+    scattered back (the chunk's own KV landed via ``write_block_kv``
+    before the call).
+
+    Grid ``(B, Nkv, ceil(G·S / BQ), T/bps)``, last axis sequential with
+    (acc, m, l) online-softmax scratch carried across it — the blocked
+    flash recurrence, causality enforced by the ``kv_pos <= q_pos``
+    position compare (intra-chunk included: the chunk's entries carry
+    their real positions).
+
+    ``nlive`` bounds per-row KV traffic by the WRITTEN frontier: the
+    index maps redirect blocks at or past ``nlive[b]`` to block 0, and
+    Pallas elides the DMA when consecutive steps name the same block —
+    so a chunk early in a long prompt streams ~its own prefix, not the
+    row's whole mapped window (decode-budget blocks included). Masking
+    already excluded those blocks (sentinel positions); the clamp is
+    pure traffic, bit-identical either way."""
+    B, S, Nh, D = q.shape
+    NB, BS, Nkv = k_arena.shape[0], k_arena.shape[1], k_arena.shape[2]
+    T = block_table.shape[1]
+    G = Nh // Nkv
+    quantized = k_scale is not None
+    if scale is None:
+        scale = D ** -0.5
+    if kv_positions.shape != (B, T * BS):
+        raise ValueError(
+            f"kv_positions must be [B, T*BS]={B, T * BS}, got "
+            f"{kv_positions.shape}"
+        )
+    if nlive is None:
+        nlive = jnp.full((B,), T, jnp.int32)
+    nlive = jnp.clip(nlive.astype(jnp.int32), 0, T)
+    bps = blocks_per_step or auto_blocks_per_step(T, BS)
+    if T % bps != 0:
+        raise ValueError(
+            f"blocks_per_step={bps} does not divide the table width {T}"
+        )
+
+    # GQA fold + query tiling (the flash_attention pattern): head h =
+    # k*G + g, folded row g*S + s carries position q_positions[s]
+    GS = G * S
+    qh = jnp.transpose(q, (0, 2, 1, 3)).reshape(B, Nkv, GS, D)
+    qp = jnp.tile(q_positions, (1, G))  # [B, GS]
+    block_q = min(BLOCK_Q_PREFILL, GS)
+    pad_q = (-GS) % block_q
+    if pad_q:
+        qh = jnp.pad(qh, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+        qp = jnp.pad(
+            qp, ((0, 0), (0, pad_q)), constant_values=jnp.int32(2**30)
+        )
+    GSp = GS + pad_q
+    qp = qp[..., None]  # [B, GSp, 1] — sublane-major (see _flash_kernel)
+    kh = jnp.transpose(k_arena, (0, 2, 1, 3))  # [NB, Nkv, BS, D]
+    vh = jnp.transpose(v_arena, (0, 2, 1, 3))
+    kp = kv_positions[:, None, :]  # [B, 1, T*BS] — lane-major
+
+    # arena-block specs: the frontier clamp lives in the INDEX MAP — a
+    # dead step re-names block 0, whose DMA Pallas elides when the index
+    # is unchanged from the previous step
+    def block_spec(j):
+        return pl.BlockSpec(
+            (1, 1, BS, D),
+            lambda b, k, i, t, tbl, nl, j=j: (
+                jnp.where(
+                    t * bps + j < nl[b], tbl[b, t * bps + j], 0
+                ),
+                k, 0, 0,
+            ),
+        )
+
+    def scale_spec(j):
+        return pl.BlockSpec(
+            (1, 1),
+            lambda b, k, i, t, tbl, nl, j=j: (
+                jnp.where(
+                    t * bps + j < nl[b], tbl[b, t * bps + j], 0
+                ),
+                k,
+            ),
+            memory_space=pltpu.SMEM,
+        )
+
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, block_q, D), lambda b, k, i, t, tbl, nl: (b, k, i, 0)
+        ),
+        *[block_spec(j) for j in range(bps)],
+        *[block_spec(j) for j in range(bps)],
+    ]
+    operands = [block_table, nlive, qh, *([kh] * bps), *([vh] * bps)]
+    if quantized:
+        in_specs += (
+            [scale_spec(j) for j in range(bps)]
+            + [scale_spec(j) for j in range(bps)]
+        )
+        operands += (
+            [k_scale.astype(jnp.float32)] * bps
+            + [v_scale.astype(jnp.float32)] * bps
+        )
+    in_specs += [
+        pl.BlockSpec(
+            (1, block_q, 1), lambda b, k, i, t, tbl, nl: (b, i, 0)
+        ),
+        pl.BlockSpec(
+            (1, 1, bps * BS), lambda b, k, i, t, tbl, nl: (b, 0, t)
+        ),
+    ]
+    operands += [qp, kp]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Nkv, GSp // block_q, T // bps),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, D), lambda b, k, i, t, tbl, nl: (b, k, i, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_prefill_kernel, scale=scale, t_steps=T // bps,
+            bps=bps, quantized=quantized,
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Nkv, GSp, D), q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=_compat.pallas_tpu_compiler_params()(
+            dimension_semantics=(
+                "parallel", "parallel", "parallel", "arbitrary",
+            ),
+        ),
+        interpret=interpret,
+    )(*operands)
+    out = out[:, :, :GS].reshape(B, Nkv, G, S, D)
+    return jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(B, S, Nh, D)
+
+
+def paged_prefill(
+    q: jnp.ndarray,
+    k_arena: jnp.ndarray,
+    v_arena: jnp.ndarray,
+    block_table: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    kv_positions: jnp.ndarray,
+    scale: float | None = None,
+    backend: str = "auto",
+    k_scale: jnp.ndarray = None,  # [NB, Nkv] — quantized arenas only
+    v_scale: jnp.ndarray = None,
+    nlive: jnp.ndarray = None,  # [B] — kernel-path traffic clamp
+) -> jnp.ndarray:
+    """Backend dispatch for CHUNKED-PREFILL attention over the arena,
+    mirroring ``paged_attention``: the Pallas prefill kernel on TPU for
+    Mosaic-eligible shapes, the exact XLA gather path otherwise;
+    ``backend`` pins a path, ``PAGED_FORCE_KERNEL`` overrides ``auto``
+    only, ``interpret`` emulates the kernel off-TPU (the CI lane).
+    Identical numerics on every path (the XLA gather is the oracle the
+    chunked-prefill tests assert against); ``nlive`` only trims kernel
+    KV traffic — the gather path reads the whole window regardless."""
+    if backend not in BACKENDS:
+        raise ValueError(
+            f"paged_prefill backend {backend!r}: expected one of "
+            f"{BACKENDS}"
+        )
+    if backend == "auto":
+        backend = forced_backend() or "auto"
+    D = q.shape[-1]
+    BS = k_arena.shape[1]
+    if backend == "interpret":
+        return paged_prefill_tpu(
+            q, k_arena, v_arena, block_table, q_positions, kv_positions,
+            scale, interpret=True, k_scale=k_scale, v_scale=v_scale,
+            nlive=nlive,
+        )
+    if backend == "kernel":
+        if jax.default_backend() != "tpu":
+            raise ValueError(
+                f"paged_prefill backend 'kernel' requires a TPU backend "
+                f"(got {jax.default_backend()}); use backend='interpret' "
+                f"(or PAGED_FORCE_KERNEL=interpret) to emulate the kernel "
+                f"off-TPU"
+            )
+        if not kernel_eligible(D, BS, k_arena.dtype):
+            raise ValueError(
+                f"paged_prefill backend 'kernel': head_dim={D} / "
+                f"block_size={BS} are not Mosaic-eligible for cache dtype "
+                f"{jnp.dtype(k_arena.dtype).name} (head_dim must be a "
+                f"multiple of 128 and the block size a sublane multiple "
+                f"— see kernel_eligible); use backend='auto' or 'xla'"
+            )
+    use_pallas = backend == "kernel" or (
+        backend == "auto"
+        and jax.default_backend() == "tpu"
+        and kernel_eligible(D, BS, k_arena.dtype)
+    )
+    if use_pallas:
+        return paged_prefill_tpu(
+            q, k_arena, v_arena, block_table, q_positions, kv_positions,
+            scale, k_scale=k_scale, v_scale=v_scale, nlive=nlive,
+        )
+    return paged_attention_xla(
+        q, k_arena, v_arena, block_table, q_positions, kv_positions, scale,
+        k_scale=k_scale, v_scale=v_scale,
+    )
 
 
 def paged_attention(

@@ -90,11 +90,12 @@ def model_fns(cfg: ModelConfig, tp_axis: Optional[str] = None) -> ModelFns:
 
     def stage_paged(cfg_, layers, h, k_arena, v_arena, tbl, cols, kv_pos,
                     positions, mask, write_valid=True, backend="auto",
-                    k_scale=None, v_scale=None):
+                    k_scale=None, v_scale=None, prefill=False, nlive=None):
         return fwd_paged(
             cfg_, layers, h, k_arena, v_arena, tbl, cols, kv_pos,
             positions, mask, write_valid=write_valid, tp_axis=tp_axis,
             backend=backend, k_scale=k_scale, v_scale=v_scale,
+            prefill=prefill, nlive=nlive,
         )
 
     return ModelFns(stage=stage, stage_paged=stage_paged)
@@ -175,7 +176,8 @@ def ring_chain(fns, cfg, layers, lmask, sidx, ring, num_stages, h, cache, positi
 
 def ring_chain_paged(fns, cfg, layers, lmask, sidx, ring, num_stages, h,
                      k_arena, v_arena, tbl, cols, kv_positions, positions,
-                     backend="auto", k_scale=None, v_scale=None):
+                     backend="auto", k_scale=None, v_scale=None,
+                     prefill=False, nlive=None):
     """``ring_chain`` over the pooled paged arena (the serve programs'
     kernel decode path): the per-microstep activity gate moves from a
     whole-cache ``_tree_where`` (which would copy the ARENA — the whole
@@ -184,7 +186,12 @@ def ring_chain_paged(fns, cfg, layers, lmask, sidx, ring, num_stages, h,
     arena update writes back the values it just read. The hidden-state
     gate is unchanged. Quantized arenas carry their scale arenas through
     the loop (None carries are empty pytree nodes — the bf16 path is
-    unchanged); returns ``(h, k_arena, v_arena, k_scale, v_scale)``."""
+    unchanged); returns ``(h, k_arena, v_arena, k_scale, v_scale)``.
+    ``prefill`` (static) runs the traversal as a CHUNKED-PREFILL one:
+    chunk-shaped queries attend through the query-tiled
+    ``paged_prefill`` kernel, with ``nlive`` clamping its per-row KV
+    streaming to the written frontier — the ``stage_paged``-style
+    prefill traversal behind ``serve_prefill_chunk``."""
 
     def micro(m, carry):
         h, ka, va, ks, vs = carry
@@ -192,7 +199,7 @@ def ring_chain_paged(fns, cfg, layers, lmask, sidx, ring, num_stages, h,
         h_new, ka, va, ks, vs = fns.stage_paged(
             cfg, layers, h, ka, va, tbl, cols, kv_positions, positions,
             lmask, write_valid=active, backend=backend,
-            k_scale=ks, v_scale=vs,
+            k_scale=ks, v_scale=vs, prefill=prefill, nlive=nlive,
         )
         h = jnp.where(active, h_new, h)
         h = jax.lax.ppermute(h, PIPE_AXIS, ring)

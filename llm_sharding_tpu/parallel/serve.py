@@ -145,63 +145,25 @@ def state_specs(state: ServeState, tp: int = 1) -> ServeState:
     )
 
 
-# ---- paged-KV window assembly (PREFILL paths only) ------------------------
+# ---- paged-KV window assembly (serve_admit's one-shot scatter only) -------
 # Inside the shard_map bodies a slot's rows are normally a dynamic SLICE of
-# the per-row cache; the paged PREFILL paths (serve_admit's fresh-window
-# scatter, serve_prefill_chunk's gather→write→scatter) instead round-trip
-# the logical [Lp, Bs, W, Nkv, Dh] window through the rows' block tables —
-# exact by construction (attention depends only on the gathered values and
-# the per-row logical kpos), and amortized over the whole chunk of prompt
-# tokens it processes. The DECODE paths (serve_chunk microsteps,
-# serve_verify traversals) no longer materialize the window at all: fresh
-# KV lands via ops/paged_attention.write_block_kv (a per-entry scatter into
-# the owning blocks) and attention runs straight off the arena through
-# ``paged_attention`` — the Pallas kernel streams exactly the blocks a row
-# owns (per-step HBM traffic ∝ blocks in flight), the XLA backend gathers
-# inside the op (the bit-exact CPU/tier-1 fallback). The scatter back may
-# hit duplicate arena blocks across rows — shared prefix blocks (every
+# the per-row cache; the one remaining full-window producer is
+# ``serve_admit``'s ONE-SHOT prefill, which builds the fresh slot window in
+# registers and scatters it through the rows' block tables below. Every
+# OTHER paged path is arena-native: decode microsteps (serve_chunk),
+# spec-verify traversals (serve_verify) AND chunked prefill
+# (serve_prefill_chunk — the ``_gather_window`` gather→recompute→scatter
+# round trip it used to pay per chunk is retired) land fresh KV via
+# ops/paged_attention.write_block_kv (a per-entry scatter into the owning
+# blocks) and attend straight off the arena through ``paged_attention`` /
+# ``paged_prefill`` — the Pallas kernels stream exactly the blocks the
+# tables name (per-step HBM traffic ∝ blocks actually written), the XLA
+# backend gathers inside the op (the bit-exact CPU/tier-1 fallback, which
+# also zero-gates trash-mapped entries — see gather_block_kv's
+# trash-zeroing contract in ops/paged_attention). The admit scatter may hit
+# duplicate arena blocks across rows — shared prefix blocks (every
 # duplicate writes the identical broadcast values) and the trash block (a
 # garbage sink) — so last-wins scatter order is immaterial.
-
-
-def _gather_window(k_arena, v_arena, tbl, block_size,
-                   k_scale=None, v_scale=None, out_dtype=None):
-    """Assemble a slot's logical K and V windows from the pooled arena:
-    ``[Lp, NB, BS, ...] , tbl [Bs, T] -> 2 × [Lp, Bs, T*BS, ...]`` — THE
-    shared helper for every surviving full-window consumer (prefill-chunk
-    continuation, admit's doc reference, host snapshot tooling). With
-    ``k_scale``/``v_scale`` (a QUANTIZED int8/fp8 arena) the gather also
-    dequantizes into ``out_dtype`` — the prefill paths compute over a
-    full-precision window and requantize only at the scatter.
-
-    Trash-zeroing contract (stated once, here): trash-mapped entries
-    (block 0) gather as ZEROS, not the trash block's contents. Parked rows
-    keep scattering garbage K/V there every microstep, and while attention
-    masks those positions to probability exactly 0, bf16 garbage can feed
-    back to ±Inf over a long run and 0 × Inf = NaN would then contaminate
-    every live row through the one SHARED block — a channel dense mode
-    (private columns) doesn't have. Zeroing is token-identical: the masked
-    positions contribute 0 either way, and in-program writes (admit prompt
-    KV, prefill-chunk continuations) land AFTER the gather, so fresh
-    values are never affected. ``ops/paged_attention`` applies the same
-    contract on the decode paths (``gather_block_kv`` zeroes at the
-    gather; the Pallas kernel gates trash blocks at the stream)."""
-    return (
-        _gather_pages(k_arena, tbl, block_size, k_scale, out_dtype),
-        _gather_pages(v_arena, tbl, block_size, v_scale, out_dtype),
-    )
-
-
-def _gather_pages(arena, tbl, block_size, scale=None, out_dtype=None):
-    """One-array gather behind ``_gather_window`` (see its contract)."""
-    g = arena[:, tbl]  # [Lp, Bs, T, BS, ...]
-    Lp, Bs, T = g.shape[0], g.shape[1], g.shape[2]
-    if scale is not None:
-        sc = scale[:, tbl]  # [Lp, Bs, T, Nkv]
-        g = kv_dequantize(g, sc[:, :, :, None, :, None], out_dtype)
-    live = (tbl != 0).reshape(1, Bs, T, 1, *([1] * (g.ndim - 4)))
-    g = jnp.where(live, g, jnp.zeros((), g.dtype))
-    return g.reshape(Lp, Bs, T * block_size, *g.shape[4:])
 
 
 def _scatter_pages(arena, tbl, window, block_size):
@@ -846,6 +808,7 @@ def serve_admit(
     jax.jit,
     static_argnames=(
         "cfg", "mesh", "num_stages", "tp", "block_size", "cache_dtype",
+        "attn",
     ),
     donate_argnums=(5,),  # see serve_admit
 )
@@ -861,14 +824,26 @@ def serve_prefill_chunk(
     #   row is past its prompt AND at each row's final real token (that token
     #   is processed later via the injection path — see serve_admit_finish)
     slot: jnp.ndarray,       # scalar int32
-    chunk_off: jnp.ndarray,  # scalar int32 cache write offset of this chunk
+    chunk_off: jnp.ndarray,  # scalar int32 SUFFIX-relative offset of this
+    #   chunk (the ``out``-buffer column of its first token); the cache
+    #   column is ``prefix_off + chunk_off``
     reset: jnp.ndarray,      # scalar bool — first chunk zeroes the slot rows
     num_stages: int,
     tp: int = 1,
     block_size: int = 0,  # static: paged-KV block size (0 = dense state)
-    cache_dtype=None,  # static: the COMPUTE dtype a quantized arena's
-    #   window dequantizes into between chunks (None → the activation
-    #   dtype); inert for dense/bf16 arenas, whose window IS the storage
+    cache_dtype=None,  # static: retained for shape-key compat; the paged
+    #   path no longer round-trips a dequantized window between chunks
+    #   (fresh KV quantizes at insert, attention dequantizes in-op)
+    prefix_off: Any = None,  # scalar int32 — logical position/column where
+    #   this admission's SUFFIX starts: a radix-hit admission with a long
+    #   leftover suffix starts at n0 > 0 with the prefix KV already
+    #   RESIDENT in the arena (shared blocks mapped read-only into the
+    #   slot rows' tables). None/0 = a cold admission. Paged-only.
+    attn: str = "xla",  # static: paged attention backend for the chunk's
+    #   arena-native attention — "xla" (gather inside the op, the exact
+    #   CPU/tier-1 fallback), "kernel" (the Pallas chunked-prefill
+    #   kernel), "interpret" (the kernel emulated, CI on CPU). Resolved
+    #   host-side by runtime/server.py; ignored in dense mode
 ):
     """One bounded chunk of an admission prefill (r2 weak #4 / next-#4).
 
@@ -877,18 +852,40 @@ def serve_prefill_chunk(
     processes ``Sc`` tokens and returns, so the host can interleave decode
     cycles between chunks (``runtime/server.py`` drives the loop). The slot
     stays inactive (``done``) until ``serve_admit_finish`` arms it; the
-    interleaved decode's unconditional garbage writes for the parked slot
-    land exactly at ``write_off[slot]``, which the next chunk (or the
-    injection step) overwrites before anything attends it.
+    interleaved decode cycles between chunks leave the parked slot's state
+    untouched (their per-entry write gating skips inactive slots), so each
+    chunk resumes exactly where the previous one stopped.
+
+    Paged mode attends the arena IN PLACE (flash-style chunked prefill —
+    ROADMAP item 3): the chunk's fresh KV lands via ``write_block_kv``
+    (quantizing at insert on an int8/fp8 arena — no inter-chunk
+    dequant→requant round trip) and its queries attend every
+    previously-written block through ``ops/paged_attention.paged_prefill``
+    (scalar-prefetched block tables, online-softmax, causal masking by
+    position — intra-chunk included), so the retired ``_gather_window``
+    round trip (gather O(W) KV, recompute, scatter O(W) back — per chunk)
+    never happens and per-chunk attention HBM traffic is bounded by the
+    written frontier, not the row's whole mapped window.
+
+    ``prefix_off`` is what makes the chunk RADIX-COMPOSABLE: with the
+    matched prefix's blocks already resident (mapped read-only into the
+    slot's tables), the first chunk seeds the prefix columns' key
+    positions (``0..n0-1`` — matches are block-aligned and gap-free by
+    construction) and every chunk writes/attends at absolute columns
+    ``n0 + chunk_off + i``. The shared prefix blocks are never written —
+    for a quantized arena that also keeps their codes+scales byte-stable
+    under concurrent readers, the same argument as ``serve_admit``'s
+    ``prefix_in_arena``.
     """
     fns = model_fns(cfg, tp_axis=TENSOR_AXIS if tp > 1 else None)
     Bs, Sc = tokens.shape
     ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
     quantized = is_kv_quantized(state.k.dtype)  # trace-time constant
-    win_dtype = cache_dtype or state.h.dtype  # quantized window target
+    if prefix_off is None:
+        prefix_off = jnp.zeros((), jnp.int32)
 
     def body(stage_layers, layer_mask, head_params, state, tokens, positions,
-             slot, chunk_off, reset):
+             slot, chunk_off, reset, prefix_off):
         layers = jax.tree.map(lambda a: a[0], stage_layers)
         lmask = layer_mask[0]
         hd = local_view(head_params)
@@ -898,63 +895,92 @@ def serve_prefill_chunk(
             state_specs(state, tp), state,
         )
         row0 = slot * Bs
-        if block_size and quantized:
-            # dequantize the already-prefilled chunks into the compute
-            # window; the scatter below requantizes the whole window with
-            # fresh per-block scales (earlier chunks pay one
-            # dequant→requant round per later chunk — the drift the
-            # kv-quant quality gate budgets for)
+        col0 = prefix_off + chunk_off  # absolute cache column of the chunk
+        p_rows = jax.lax.dynamic_slice_in_dim(st.kpos, row0, Bs, axis=0)
+        W = p_rows.shape[1]
+        scale_upd = {}
+        if block_size:
             tbl = _slot_tables(st, row0, Bs)
-            k_rows, v_rows = _gather_window(
-                st.k, st.v, tbl, block_size, st.k_scale, st.v_scale,
-                win_dtype,
+            # first chunk: the resident prefix columns carry their real
+            # positions (block-aligned radix matches are gap-free, so
+            # position == column), everything past them the sentinel —
+            # stale values in reallocated private blocks are masked out
+            # (finite previous-occupant KV; the trash block is zero-gated
+            # by the attention op, so no NaN channel)
+            colidx = jnp.arange(W, dtype=jnp.int32)[None, :]
+            kpos0 = jnp.where(colidx < prefix_off, colidx, POS_SENTINEL)
+            p_rows = jnp.where(
+                reset, jnp.broadcast_to(kpos0, p_rows.shape), p_rows
             )
-        elif block_size:
-            tbl = _slot_tables(st, row0, Bs)
-            k_rows, v_rows = _gather_window(st.k, st.v, tbl, block_size)
+            kv_pos = jax.lax.dynamic_update_slice(p_rows, positions, (0, col0))
+            cols = jnp.broadcast_to(
+                col0 + jnp.arange(Sc, dtype=jnp.int32)[None, :], (Bs, Sc)
+            )
+            if quantized:
+                # reset the slot's PRIVATE blocks' running-absmax scales
+                # on the first chunk: a previous occupant's (or a parked
+                # interleave's) inflated scale would otherwise coarsen
+                # every fresh entry this admission inserts — the shared
+                # radix prefix blocks (and trash, whose scale is never
+                # dequantized) keep theirs
+                n_pfx = prefix_off // block_size
+                bidx = jnp.arange(tbl.shape[1], dtype=jnp.int32)[None, :]
+                priv = jnp.where(bidx >= n_pfx, tbl, 0)
+                ks = jnp.where(
+                    reset, st.k_scale.at[:, priv].set(0.0), st.k_scale
+                )
+                vs = jnp.where(
+                    reset, st.v_scale.at[:, priv].set(0.0), st.v_scale
+                )
+            else:
+                ks = vs = None
+            # blocks covering the written frontier after this chunk — the
+            # prefill kernel's per-row KV traffic clamp (sentinel masking
+            # already excludes everything past it)
+            nlive = jnp.broadcast_to(
+                (col0 + Sc + block_size - 1) // block_size, (Bs,)
+            ).astype(jnp.int32)
+            h = sp_embed(cfg, hd, tokens, positions)
+            h, k_new, v_new, ks_new, vs_new = ring_chain_paged(
+                fns, cfg, layers, lmask, sidx, ring, num_stages, h,
+                st.k, st.v, tbl, cols, kv_pos, positions, backend=attn,
+                k_scale=ks, v_scale=vs, prefill=True, nlive=nlive,
+            )
+            if quantized:
+                scale_upd = {"k_scale": ks_new, "v_scale": vs_new}
+            kpos_new = jax.lax.dynamic_update_slice_in_dim(
+                st.kpos, kv_pos, row0, axis=0
+            )
         else:
             k_rows = jax.lax.dynamic_slice_in_dim(st.k, row0, Bs, axis=1)
             v_rows = jax.lax.dynamic_slice_in_dim(st.v, row0, Bs, axis=1)
-        p_rows = jax.lax.dynamic_slice_in_dim(st.kpos, row0, Bs, axis=0)
-        zero = jnp.zeros_like(k_rows)
-        sent = jnp.full_like(p_rows, POS_SENTINEL)
-        cache = KVCache(
-            k=jnp.where(reset, zero, k_rows),
-            v=jnp.where(reset, zero, v_rows),
-            pos=jnp.where(reset, sent, p_rows),
-            length=chunk_off,
-        )
-        h = sp_embed(cfg, hd, tokens, positions)
-        h, cache = ring_chain(
-            fns, cfg, layers, lmask, sidx, ring, num_stages, h, cache,
-            positions,
-        )
-
-        scale_upd = {}
-        if block_size and quantized:
-            k_new, ks_new = _scatter_pages_q(
-                st.k, st.k_scale, tbl, cache.k, block_size
+            zero = jnp.zeros_like(k_rows)
+            sent = jnp.full_like(p_rows, POS_SENTINEL)
+            cache = KVCache(
+                k=jnp.where(reset, zero, k_rows),
+                v=jnp.where(reset, zero, v_rows),
+                pos=jnp.where(reset, sent, p_rows),
+                length=chunk_off,
             )
-            v_new, vs_new = _scatter_pages_q(
-                st.v, st.v_scale, tbl, cache.v, block_size
+            h = sp_embed(cfg, hd, tokens, positions)
+            h, cache = ring_chain(
+                fns, cfg, layers, lmask, sidx, ring, num_stages, h, cache,
+                positions,
             )
-            scale_upd = {"k_scale": ks_new, "v_scale": vs_new}
-        elif block_size:
-            k_new = _scatter_pages(st.k, tbl, cache.k, block_size)
-            v_new = _scatter_pages(st.v, tbl, cache.v, block_size)
-        else:
             k_new = jax.lax.dynamic_update_slice_in_dim(
                 st.k, cache.k, row0, axis=1
             )
             v_new = jax.lax.dynamic_update_slice_in_dim(
                 st.v, cache.v, row0, axis=1
             )
-        kpos_new = jax.lax.dynamic_update_slice_in_dim(
-            st.kpos, cache.pos, row0, axis=0
-        )
-        write_off = st.write_off.at[slot].set(chunk_off + Sc)
+            kpos_new = jax.lax.dynamic_update_slice_in_dim(
+                st.kpos, cache.pos, row0, axis=0
+            )
+        write_off = st.write_off.at[slot].set(col0 + Sc)
         # accumulate the prompt into the replicated out buffer chunk by chunk
-        # (first chunk clears the previous occupant's rows)
+        # (first chunk clears the previous occupant's rows). Columns stay
+        # SUFFIX-relative (chunk_off) like the one-shot radix admission: a
+        # resident prefix's ids live in the tree, not in ``out``.
         out_rows = jax.lax.dynamic_slice_in_dim(st.out, row0, Bs, axis=0)
         out_rows = jnp.where(reset, jnp.zeros_like(out_rows), out_rows)
         out = jax.lax.dynamic_update_slice_in_dim(st.out, out_rows, row0, axis=0)
@@ -976,12 +1002,12 @@ def serve_prefill_chunk(
         in_specs=(
             stage_layer_specs(cfg, tp, stage_layers), P(PIPE_AXIS),
             head_specs(head_params), specs,
-            P(), P(), P(), P(), P(),
+            P(), P(), P(), P(), P(), P(),
         ),
         out_specs=specs,
         check_vma=False,
     )(stage_layers, layer_masks, head_params, state, tokens, positions,
-      slot, chunk_off, reset)
+      slot, chunk_off, reset, jnp.asarray(prefix_off, jnp.int32))
 
 
 @functools.partial(
@@ -1202,7 +1228,15 @@ def serve_chunk(
                 # kernel streams only the slot's mapped blocks; the XLA
                 # backend gathers inside the op (exact fallback). Key
                 # positions are recorded at the write column exactly as
-                # scan_layers does for the dense window.
+                # scan_layers does for the dense window. The write itself
+                # is gated by ``advance`` (write_block_kv's per-entry
+                # valid — cheap, unlike the dense path's whole-cache
+                # where): a PARKED slot (mid-chunked-admission, or a dead
+                # block in flight) must not scatter garbage into its live
+                # mapped blocks — the arena-native prefill path no longer
+                # re-scatters the window between chunks, and on a
+                # quantized arena a garbage write would permanently
+                # inflate the touched block's running-absmax scale.
                 tbl_r = _slot_tables(s, row0, Bs)
                 kpos_rows = jax.lax.dynamic_slice_in_dim(
                     s.kpos, row0, Bs, axis=0
@@ -1213,7 +1247,8 @@ def serve_chunk(
                 h_new, k_st, v_st, ks_st, vs_st = fns.stage_paged(
                     cfg, layers, h_in, s.k, s.v, tbl_r,
                     jnp.broadcast_to(off_r, (Bs, 1)), kv_pos,
-                    pos_rows[:, None], lmask, backend=attn,
+                    pos_rows[:, None], lmask, write_valid=advance,
+                    backend=attn,
                     k_scale=s.k_scale if quantized else None,
                     v_scale=s.v_scale if quantized else None,
                 )
@@ -1221,7 +1256,9 @@ def serve_chunk(
                     {"k_scale": ks_st, "v_scale": vs_st} if quantized
                     else {}
                 )
-                kpos_st = upd(s.kpos, kv_pos, 0)
+                kpos_st = upd(
+                    s.kpos, jnp.where(advance, kv_pos, kpos_rows), 0
+                )
             else:
                 cache_r = KVCache(
                     k=jax.lax.dynamic_slice_in_dim(s.k, row0, Bs, axis=1),

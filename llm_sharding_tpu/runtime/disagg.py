@@ -25,7 +25,12 @@ primitives already in-tree:
   operand is GATHERED from the arena (``gather_prefix_kv``), so the
   decode replica performs ZERO prefill FLOPs for the streamed prefix and
   the continuation is token-identical to the unified run by the same
-  argument as any radix hit.
+  argument as any radix hit. The stream + adopt run on a SIDECAR thread
+  by default (``async_handoff``): the router's step thread only routes,
+  fault-checks and extracts — a long-prompt hand-off's copy time no
+  longer stalls every live stream's decode pump (the old
+  ``serve_disagg_itl_*`` p99 tail); ``close()`` rendezvouses with the
+  sidecar before tearing replicas down.
 - **planner** (``runtime/placement.PlacementPlanner``): the profiler's
   fitted prefill/decode latency models (``profiler.fit_latency_models`` /
   a saved ``profile.json``) choose (a) the prefill:decode replica ratio
@@ -52,6 +57,8 @@ holds on every path because each fallback is an already-proven path
 from __future__ import annotations
 
 import logging
+import queue
+import threading
 import time
 import weakref
 from typing import Optional
@@ -94,6 +101,9 @@ class DisaggServer(ReplicatedServer):
         planner=None,  # runtime.placement.PlacementPlanner (optional)
         handoff_retries: int = 3,
         cross_fill: bool = True,
+        async_handoff: bool = True,  # stream+adopt on a sidecar thread
+        #   (False = the pre-PR-14 synchronous hand-off, for
+        #   deterministic tests)
         **kw,
     ):
         if roles is not None and prefill_replicas is not None:
@@ -155,6 +165,20 @@ class DisaggServer(ReplicatedServer):
         self.planner = planner
         self.handoff_retries = int(handoff_retries)
         self.cross_fill = bool(cross_fill)
+        # async hand-off sidecar (ROADMAP 1a): the device→host→device KV
+        # stream + adopt run OFF the router's step thread, so a
+        # long-prompt hand-off no longer stalls every live stream's
+        # decode pump for its copy time (the serve_disagg_itl_* ITL p99
+        # tail). The step thread still does the cheap irreversible part
+        # (fault check, route, extract) so retry/fallback semantics are
+        # unchanged; the sidecar adopts ONLY AFTER the stream landed (or
+        # terminally failed — the cold adopt is the proven fallback).
+        self.async_handoff = bool(async_handoff)
+        self._handoff_jobs: "queue.Queue" = queue.Queue()
+        self._handoff_thread: Optional[threading.Thread] = None
+        self._handoff_inflight = 0
+        self._handoff_cv = threading.Condition()
+        self._handoff_stop = False  # close(): fail queued jobs typed
         # requests awaiting their prefill→decode hand-off (Request →
         # transient-fault attempt count); entries drop when the request
         # finishes, fails, hands off, or migrates off the prefill side
@@ -494,6 +518,141 @@ class DisaggServer(ReplicatedServer):
                 self._pending_handoff[req] = attempts
             logger.info("hand-off of request %d deferred: %s", req.id, e)
             return False
+        if self.async_handoff:
+            # the expensive half — device→host→device stream + adopt —
+            # moves to the sidecar; this step thread's pump continues
+            # immediately. The request is already extracted (off src's
+            # rows/queue), so neither the sweep nor the reconciliation
+            # pass can double-enqueue it meanwhile.
+            with self._handoff_cv:
+                self._handoff_inflight += 1
+            self._ensure_handoff_thread()
+            self._handoff_jobs.put((req, src, dst, st, attempts, t0))
+            return True
+        return self._handoff_land(req, src, dst, st, attempts, t0)
+
+    def _ensure_handoff_thread(self) -> None:
+        if self._handoff_thread is None or not self._handoff_thread.is_alive():
+            self._handoff_thread = threading.Thread(
+                target=self._handoff_worker,
+                name="disagg-handoff",
+                daemon=True,
+            )
+            self._handoff_thread.start()
+
+    def _handoff_worker(self) -> None:
+        """Sidecar loop: land queued hand-offs one at a time (stream,
+        then adopt). Every failure mode inside ``_handoff_land`` is
+        already contained (cold adopt, fallback adopt, typed fail); the
+        outer catch is a backstop so a bug can never strand a request in
+        the extracted no-man's-land with consumers blocked forever."""
+        while True:
+            job = self._handoff_jobs.get()
+            if job is None:
+                return
+            req, src = job[0], job[1]
+            try:
+                if self._handoff_stop:
+                    # shutdown drained past the rendezvous timeout: do not
+                    # land against replicas that are being torn down —
+                    # fail the extracted request typed instead of letting
+                    # the stream race the closing arenas
+                    raise ServerClosed(
+                        "router closed before the hand-off landed"
+                    )
+                self._handoff_land(*job)
+            except Exception as e:  # noqa: BLE001 — backstop (see above)
+                if not isinstance(e, ServerClosed):
+                    logger.exception(
+                        "async hand-off of request %d crashed", req.id
+                    )
+                try:
+                    # under the router lock like every other failure path:
+                    # _fail_request mutates rows/allocator/table mirrors
+                    # that the step thread touches too
+                    with self._lock:
+                        src._fail_request(req, RequestFailed(
+                            f"request {req.id} was lost in an async "
+                            f"hand-off crash: {e!r}", req,
+                        ))
+                except Exception:  # noqa: BLE001
+                    pass
+            finally:
+                with self._handoff_cv:
+                    self._handoff_inflight -= 1
+                    self._handoff_cv.notify_all()
+
+    def _await_handoffs(self, timeout: float = 30.0) -> bool:
+        """Completion rendezvous: block until every sidecar hand-off has
+        landed (or ``timeout`` elapses). Called WITHOUT the router lock —
+        the sidecar needs it to finish. True = drained."""
+        with self._handoff_cv:
+            return self._handoff_cv.wait_for(
+                lambda: self._handoff_inflight == 0, timeout
+            )
+
+    def handoffs_pending(self) -> int:
+        """Hand-offs not yet landed: swept-but-unstarted entries plus
+        sidecar jobs in flight (what benches/tests should poll — the
+        ``_pending_handoff`` dict alone misses the async window)."""
+        with self._handoff_cv:
+            return len(self._pending_handoff) + self._handoff_inflight
+
+    def run_until_idle(self) -> None:
+        """Base idling plus the async rendezvous: a request mid-sidecar
+        is on NO replica (extracted, not yet adopted), so the base
+        all-replicas-idle condition alone would return while its stream
+        is still landing."""
+        while True:
+            super().run_until_idle()
+            with self._handoff_cv:
+                inflight = self._handoff_inflight
+            if inflight:
+                self._await_handoffs(timeout=0.1)
+                continue
+            # no sidecar work; a live swept-but-unstarted entry implies a
+            # live row somewhere, which the base condition already covers
+            if not any(not r.done for r in self._pending_handoff):
+                return
+            self.step()
+
+    def close(self) -> None:
+        # rendezvous BEFORE closing replicas: in-flight sidecar
+        # hand-offs adopt (or terminally fall back) first, so a shutdown
+        # cannot race a stream against a closing arena; then stop the
+        # worker so the process exits cleanly. A rendezvous that TIMES
+        # OUT (a hung device copy, a deep job backlog) must not tear the
+        # replicas down under a still-running stream silently: flag the
+        # worker to fail remaining jobs typed instead of landing them,
+        # and say so loudly.
+        if not self._await_handoffs():
+            logger.warning(
+                "close: async hand-offs still in flight after the "
+                "rendezvous timeout — remaining jobs will fail typed "
+                "(ServerClosed) instead of landing"
+            )
+        self._handoff_stop = True
+        if self._handoff_thread is not None:
+            self._handoff_jobs.put(None)
+            self._handoff_thread.join(timeout=5.0)
+            if self._handoff_thread.is_alive():
+                logger.warning(
+                    "close: hand-off sidecar did not exit within 5s "
+                    "(a device copy may be hung); proceeding with "
+                    "replica teardown"
+                )
+            self._handoff_thread = None
+        super().close()
+
+    def _handoff_land(
+        self, req: Request, src: PipelineServer, dst: PipelineServer,
+        st, attempts: int, t0: float,
+    ) -> bool:
+        """Land an extracted request on the decode side: stream the
+        prompt's KV blocks (OUTSIDE the router lock — the copy is the
+        stall the sidecar exists to absorb), then adopt under the lock.
+        Identical semantics whether called inline (sync mode, under the
+        sweep's reentrant lock) or from the sidecar."""
         streamed = nbytes = 0
         try:
             streamed, nbytes = self._stream_prefix(src, dst, st.prompt)
@@ -503,6 +662,15 @@ class DisaggServer(ReplicatedServer):
             logger.exception(
                 "KV streaming for request %d failed; adopting cold", req.id
             )
+        with self._lock:
+            return self._adopt_streamed(
+                req, src, dst, st, attempts, t0, streamed, nbytes
+            )
+
+    def _adopt_streamed(
+        self, req: Request, src: PipelineServer, dst: PipelineServer,
+        st, attempts: int, t0: float, streamed: int, nbytes: int,
+    ) -> bool:
         try:
             dst.adopt(st, req, front=True)
         except (ValueError, RuntimeError) as e:
@@ -521,11 +689,11 @@ class DisaggServer(ReplicatedServer):
                 self._decision(
                     "handoff", req=req, dur_s=time.perf_counter() - t0,
                     outcome="fallback", reason="refused_adopt",
-                    dst=self._group_of[t], attempts=attempts,
+                    dst=self._group_of.get(t), attempts=attempts,
                 )
                 logger.warning(
                     "hand-off target refused request %d; adopted by "
-                    "replica %d instead", req.id, self._group_of[t],
+                    "replica %s instead", req.id, self._group_of.get(t),
                 )
                 return True
             src._fail_request(req, RequestFailed(
@@ -546,17 +714,20 @@ class DisaggServer(ReplicatedServer):
             np.asarray(st.prompt, np.int32)
         ) > 0
         DISAGG_HANDOFFS.labels(outcome="ok" if warm else "cold").inc()
+        # .get(): the SOURCE may have been failed over/retired while the
+        # sidecar was mid-stream — the adopt is still valid (the state is
+        # host-side), the attribution just names a dead group
+        frm, to = self._group_of.get(src), self._group_of.get(dst)
         self._decision(
             "handoff", req=req, dur_s=time.perf_counter() - t0,
             outcome="ok" if warm else "cold",
-            frm=self._group_of[src], dst=self._group_of[dst],
+            frm=frm, dst=to,
             streamed=streamed, bytes=nbytes, attempts=attempts,
         )
         logger.info(
-            "hand-off id=%d replica %d → %d (%d prefix tokens streamed, "
+            "hand-off id=%d replica %s → %s (%d prefix tokens streamed, "
             "%d generated so far)",
-            req.id, self._group_of[src], self._group_of[dst], streamed,
-            len(req.tokens),
+            req.id, frm, to, streamed, len(req.tokens),
         )
         return True
 
@@ -591,9 +762,16 @@ class DisaggServer(ReplicatedServer):
                 return 0, 0
             try:
                 n = ref.n
-                kv = src._read_arena_blocks(ref.blocks)
+                # dispatch-only under the mutex; the device→host
+                # materialization below runs OUTSIDE it, so the source's
+                # step pump is never frozen for the copy time (device
+                # streams execute in enqueue order — the gather reads
+                # the pre-release bytes even if the blocks recycle)
+                kv_dev = src._read_arena_blocks_dispatch(ref.blocks)
             finally:
                 src._radix.release(ref)
+        kv = tuple(np.asarray(a) for a in kv_dev)
+        del kv_dev
         with dst._mutex:
             have = dst._radix.match_tokens(ids[:n])
             if have >= n:
@@ -832,6 +1010,7 @@ class DisaggServer(ReplicatedServer):
         out["roles"] = {
             str(d): r for d, r in sorted(self.roles.items())
         }
-        out["pending_handoffs"] = len(self._pending_handoff)
+        out["pending_handoffs"] = self.handoffs_pending()
         out["planner"] = self.planner is not None
+        out["async_handoff"] = self.async_handoff
         return out

@@ -77,7 +77,8 @@ from ..obs.metrics import (
     ARENA_BYTES, ATTN_BACKEND, ATTN_BACKENDS, ATTN_BLOCKS_READ,
     DEFAULT_RATE_BUCKETS,
     KV_BLOCKS_IN_USE, KV_BLOCKS_TOTAL, KV_HOST_TIER_BLOCKS, KV_WASTE_FRAC,
-    PREFIX_HIT_RATE, PREFIX_HIT_TOKENS, REGISTRY, record_shape_key,
+    PREFILL_BLOCKS_READ, PREFIX_HIT_RATE, PREFIX_HIT_TOKENS, REGISTRY,
+    record_shape_key, set_prefill_path,
 )
 from ..obs.trace import TraceContext, TraceWriter, emit_span
 from ..parallel import serve as serve_ops
@@ -2484,10 +2485,14 @@ class PipelineServer:
             plen = req.prompt_len
             # a chunk-admitted row's FINAL prompt token rides the injection
             # path — its KV lands past the bucket region, so the contiguous
-            # cacheable run ends one token early there
+            # cacheable run ends one token early there. Chunking is decided
+            # by the SUFFIX bucket past any radix hit (a hit with a long
+            # leftover suffix admits chunked too; its resident-prefix
+            # length is the pinned ref's)
+            spx_n = rref.n if rref is not None else 0
             chunked = (
-                rref is None and self.prefill_chunk is not None
-                and self._chunked(self._bucket(plen))
+                self.prefill_chunk is not None and plen > spx_n
+                and self._chunked(self._bucket(plen - spx_n))
             )
             nb = (plen - (1 if chunked else 0)) // bs
             cand = [int(b) for b in self._tables[row][:nb]]
@@ -2525,6 +2530,27 @@ class PipelineServer:
 
     # ------------------------------------ automatic prefix cache internals
 
+    def _read_arena_blocks_dispatch(self, blocks) -> tuple:
+        """Dispatch-only half of ``_read_arena_blocks``: enqueue the
+        block gathers and return DEVICE arrays (call ``np.asarray`` on
+        them OUTSIDE the serving mutex). Value-correct even though later
+        dispatches may donate/rewrite the arena: device streams execute
+        in enqueue order, so the gather reads the bytes as of this
+        dispatch — which is what lets the disagg hand-off sidecar pull
+        the device→host copy off the router's step thread without
+        freezing this server's pump for the copy's duration."""
+        idx = jnp.asarray(np.asarray(list(blocks), np.int32))
+        out = [
+            jnp.take(self.state.k, idx, axis=2),
+            jnp.take(self.state.v, idx, axis=2),
+        ]
+        if self.kv_quantized:
+            out += [
+                jnp.take(self.state.k_scale, idx, axis=2),
+                jnp.take(self.state.v_scale, idx, axis=2),
+            ]
+        return tuple(out)
+
     def _read_arena_blocks(self, blocks) -> tuple:
         """Device→host copy of arena blocks (radix host-tier demotion).
         Returns (k, v) numpy ``[S, Lp, nb, BS, Nkv, Dh]`` in the ARENA
@@ -2534,14 +2560,9 @@ class PipelineServer:
         the cached tokens per host-RAM byte too (the radix tree slices
         every component along its block axis 2 and never interprets
         them)."""
-        idx = jnp.asarray(np.asarray(list(blocks), np.int32))
-        k = np.asarray(jnp.take(self.state.k, idx, axis=2))
-        v = np.asarray(jnp.take(self.state.v, idx, axis=2))
-        if not self.kv_quantized:
-            return k, v
-        ks = np.asarray(jnp.take(self.state.k_scale, idx, axis=2))
-        vs = np.asarray(jnp.take(self.state.v_scale, idx, axis=2))
-        return k, v, ks, vs
+        return tuple(
+            np.asarray(a) for a in self._read_arena_blocks_dispatch(blocks)
+        )
 
     def _write_arena_blocks(self, blocks, k_host, v_host, *scales) -> None:
         """Host→device restore of demoted blocks into freshly allocated
@@ -2593,10 +2614,13 @@ class PipelineServer:
         (cold admission). Usable means: block-aligned, leaves at least one
         suffix token (the first output samples from the suffix's last
         position), and the prefix-row layout ``n + bucket(suffix) +
-        max_new`` fits capacity and the position budget WITHOUT chunked
-        admission (prefix admissions are one-shot; a hit shrinks the
-        suffix, so the cold chunked path only wins when there is nothing
-        to reuse)."""
+        max_new`` (+1 when the suffix admits CHUNKED — the injected final
+        prompt token's extra slot) fits capacity and the position budget.
+        A suffix too long for one-shot admission composes with chunked
+        prefill — ``serve_prefill_chunk`` starts at prefix offset ``n``
+        with the matched KV already resident in the arena — so a radix
+        hit with a long leftover suffix no longer falls back cold (the
+        old one-shot-only restriction; ROADMAP item 3)."""
         if (
             self._radix is None or req.prefix is not None
             or req.embeds is not None
@@ -2609,10 +2633,12 @@ class PipelineServer:
 
         def usable(n: int) -> bool:
             bucket = self._bucket(plen - n)
-            total = n + bucket + req.max_new
+            total = (
+                n + bucket + req.max_new
+                + (1 if self._chunked(bucket) else 0)
+            )
             return (
-                not self._chunked(bucket)
-                and total <= self.capacity
+                total <= self.capacity
                 and total <= self.cfg.max_position_embeddings
             )
 
@@ -3281,10 +3307,12 @@ class PipelineServer:
             # prefix — every row's table maps the same shared blocks, like
             # the one-handle rule (the common case IS shared traffic: N
             # requests over one system prompt).
+            # a radix hit composes with chunked admission: the suffix
+            # bucket decides, and serve_prefill_chunk starts at prefix
+            # offset spx_n with the matched KV already resident
             bucket = self._bucket(head.prompt_len - spx_n)
             chunked = (
-                not is_emb and pfx is None and rplan is None
-                and self._chunked(bucket)
+                not is_emb and pfx is None and self._chunked(bucket)
             )
             spx = pfx.spx if pfx is not None else spx_n
 
@@ -3322,7 +3350,7 @@ class PipelineServer:
                 # submit validated against the full-prompt bucket, which
                 # can be SMALLER than spx + suffix bucket at small block
                 # sizes — usable() only vetted the head's max_new
-                total = spx_n + bucket + r.max_new
+                total = spx_n + bucket + r.max_new + (1 if chunked else 0)
                 return (
                     r.prompt_len > spx_n
                     and self._bucket(r.prompt_len - spx_n) == bucket
@@ -3434,12 +3462,16 @@ class PipelineServer:
                 self._fault_check("admit_dispatch")
                 carried = bool(rng_mask.any())
                 if (
-                    not is_emb and pfx is None and rplan is None
+                    not is_emb and pfx is None
                     and self._chunked(bucket)
                 ):
+                    # chunked admission — cold (prefix_off 0) or from a
+                    # radix hit's offset, with the matched blocks already
+                    # mapped read-only into the slot rows' tables
                     self._admit_chunked(
                         slot, prompts, plen, row_valid, max_new, seeds,
                         temps, topks, topps, rngs, rng_mask,
+                        prefix_off=spx_n,
                     )
                     return
                 if pfx is not None:
@@ -3573,7 +3605,7 @@ class PipelineServer:
 
     def _admit_chunked(
         self, slot, prompts, plen, row_valid, max_new, seeds, temps,
-        topks, topps, rngs=None, rng_mask=None,
+        topks, topps, rngs=None, rng_mask=None, prefix_off: int = 0,
     ) -> None:
         """Chunked admission: bounded prefill chunks with one decode cycle
         interleaved after each, so in-flight slots keep producing tokens
@@ -3582,22 +3614,49 @@ class PipelineServer:
         at the program-granularity level). Each row's final real prompt token
         is sentinel-masked out of the prefill and parked in the injection
         path by ``serve_admit_finish``; the slot's first microstep computes
-        it and the normal completion path samples the first token."""
+        it and the normal completion path samples the first token.
+
+        Paged chunks attend the arena in place through the resolved
+        ``paged_attn`` backend (the flash-style chunked-prefill kernel /
+        its exact XLA-gather fallback — no gathered-window round trip).
+        ``prefix_off`` > 0 is a RADIX-HIT chunked admission: ``prompts``
+        carries only each request's suffix, chunks run at absolute
+        positions/columns ``prefix_off + i`` against the matched prefix's
+        blocks already resident in the arena, and ``serve_admit_finish``
+        arms the slot with the prefix-inclusive total length."""
         Bs, bucket = prompts.shape
         Sc = self.prefill_chunk
         row0 = slot * Bs
         self._admitting_rows.update(range(row0, row0 + Bs))
         idx = np.arange(bucket, dtype=np.int32)[None, :]
-        positions = np.where(idx < plen[:, None], idx, serve_ops.POS_SENTINEL)
+        # absolute positions: the suffix starts at prefix_off
+        positions = np.where(
+            idx < plen[:, None], prefix_off + idx, serve_ops.POS_SENTINEL
+        )
         # mask each row's final real token — processed via injection instead
         positions[np.arange(Bs), np.maximum(plen - 1, 0)] = serve_ops.POS_SENTINEL
+        # the dispatched static, not attn_impl (see _dispatch_chunk)
+        attn = self.attn_impl if self.paged else "xla"
+        set_prefill_path(
+            "gather" if not self.paged
+            else ("xla" if attn == "xla" else "kernel")
+        )
         record_shape_key(
             "serve_prefill_chunk",
             (self.num_stages, Bs, self.capacity, Sc, self.tp,
-             self.kv_block_size),
+             self.kv_block_size, attn, self.kv_dtype),
         )
+        n_valid = int(row_valid.sum())
         for ci, off in enumerate(range(0, bucket, Sc)):
             self._flush_tables()
+            if self.paged:
+                # blocks this chunk's queries attend = the written
+                # frontier (prefix + chunks through this one), per row
+                PREFILL_BLOCKS_READ.inc(
+                    n_valid * (
+                        -(-(prefix_off + off + Sc) // self.kv_block_size)
+                    )
+                )
             self.state = serve_ops.serve_prefill_chunk(
                 self.cfg,
                 self.mesh,
@@ -3614,6 +3673,8 @@ class PipelineServer:
                 tp=self.tp,
                 block_size=self.kv_block_size or 0,
                 cache_dtype=self.engine.cache_dtype,
+                prefix_off=jnp.asarray(prefix_off, jnp.int32),
+                attn=attn,
             )
             # interleave only when some OTHER request is mid-decode — the
             # admitting rows themselves are in _rows already and must not
@@ -3623,7 +3684,7 @@ class PipelineServer:
                     "serve_chunk",
                     (self.num_stages, self.batch_per_slot, self.capacity,
                      self.num_stages, self._sampling, self._filtering,
-                     self.tp, self.kv_block_size),
+                     self.tp, self.kv_block_size, attn, self.kv_dtype),
                 )
                 self._flush_tables()
                 self.state, log = serve_ops.serve_chunk(
@@ -3639,6 +3700,7 @@ class PipelineServer:
                     self._filtering,
                     tp=self.tp,
                     block_size=self.kv_block_size or 0,
+                    attn=attn,
                 )
                 self._pending.append(
                     ("chunk",
@@ -3660,7 +3722,9 @@ class PipelineServer:
             self.engine.head_params,
             self.state,
             jnp.asarray(last_tok),
-            jnp.asarray(plen),
+            # prefix-inclusive totals: pos_slots / lengths / budget and
+            # the injected token's position all count the resident prefix
+            jnp.asarray(prefix_off + plen),
             jnp.asarray(row_valid),
             jnp.asarray(slot, jnp.int32),
             jnp.asarray(max_new),
