@@ -21,6 +21,28 @@ Public surface:
                           spans, /metrics + /statz HTTP exposition
 """
 
-from . import models, obs, ops, parallel, profiler, runtime, utils  # noqa: F401
+import importlib
+
+#: Subpackages resolved lazily (PEP 562): ``llm_sharding_tpu.models`` etc.
+#: import on first attribute access instead of at package import. This is
+#: what lets the jax-free entry points — ``python -m llm_sharding_tpu
+#: lint`` and ``trace-report`` — run in <10 s on hosts with no accelerator
+#: stack: importing the package no longer drags jax in.
+_SUBMODULES = (
+    "analysis", "models", "obs", "ops", "parallel", "profiler", "runtime",
+    "utils",
+)
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name: str):
+    if name in _SUBMODULES:
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_SUBMODULES))

@@ -1,0 +1,23 @@
+"""shardlint: the repo-native static-analysis pass.
+
+``python -m llm_sharding_tpu lint`` drives :func:`core.run_lint` over the
+package source — jax-free, AST-based, gating CI. Rule catalog:
+
+- ``dispatch-statics``  — every static reaching a jitted program appears
+  in its recorded shape key (the PR-12 double-compile class);
+- ``donation-safety``   — donated buffers are dead after dispatch; retry
+  wrappers around donating dispatches are ``real_ok=False``;
+- ``lock-order``        — the static lock-acquisition graph respects the
+  canonical hierarchy in :mod:`.lockorder` (no cycles, no unregistered
+  locks);
+- ``metrics-discipline``— registrations have help text + README rows (and
+  vice versa), label sets consistent at feed sites;
+- ``trace-discipline``  — emitted span names match the README span-schema
+  table (and vice versa).
+
+This ``__init__`` stays import-light on purpose: the runtime modules
+import :mod:`.lockorder` (``named_lock``) at construction time, and
+``obs.metrics`` must remain importable without dragging anything in.
+"""
+
+__all__ = ["core", "lockorder"]

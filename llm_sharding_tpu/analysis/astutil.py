@@ -1,0 +1,210 @@
+"""Shared AST plumbing for the shardlint rules.
+
+Everything here is pure-stdlib ``ast`` work: dotted-path extraction,
+parent/scope maps, literal resolution. The rules stay readable because the
+mechanical tree-walking lives here.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``self.engine.cache_dtype`` → the literal dotted path, or ``None``
+    for anything that is not a pure Name/Attribute chain (a call result, a
+    subscript, ...)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def ref_paths(node: ast.AST) -> set:
+    """Every dotted Name/Attribute path read anywhere inside ``node``.
+    Attribute chains contribute their LONGEST path only (``self.kv_dtype``
+    yields ``self.kv_dtype``, not also ``self``)."""
+    out: set = set()
+
+    class _V(ast.NodeVisitor):
+        def visit_Attribute(self, n: ast.Attribute):
+            d = dotted(n)
+            if d is not None:
+                out.add(d)
+                return  # longest chain only: do not descend into n.value
+            self.generic_visit(n)
+
+        def visit_Name(self, n: ast.Name):
+            out.add(n.id)
+
+    _V().visit(node)
+    return out
+
+
+def is_constant_expr(node: ast.AST) -> bool:
+    """True for expressions with no runtime-varying inputs (literals and
+    tuples/unary ops over literals)."""
+    return all(
+        isinstance(
+            n,
+            (
+                ast.Constant, ast.Tuple, ast.List, ast.UnaryOp, ast.BinOp,
+                ast.USub, ast.UAdd, ast.Load, ast.operator, ast.unaryop,
+                ast.expr_context,
+            ),
+        )
+        for n in ast.walk(node)
+    )
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    """Terminal name of the called thing: ``serve_ops.serve_chunk(...)`` →
+    ``serve_chunk``; ``foo(...)`` → ``foo``."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def literal_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def enclosing_function(
+    node: ast.AST, parents: Dict[ast.AST, ast.AST]
+) -> Optional[ast.AST]:
+    """Nearest enclosing FunctionDef/AsyncFunctionDef (or None at module
+    scope)."""
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def enclosing_class(
+    node: ast.AST, parents: Dict[ast.AST, ast.AST]
+) -> Optional[ast.ClassDef]:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def walk_calls(node: ast.AST) -> Iterator[ast.Call]:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            yield n
+
+
+def store_paths(node: ast.AST) -> set:
+    """Dotted paths assigned (Store context) anywhere inside ``node`` —
+    assignment targets, aug-assign targets, for-loop targets, with-as."""
+    out: set = set()
+    for n in ast.walk(node):
+        targets: Sequence[ast.AST] = ()
+        if isinstance(n, ast.Assign):
+            targets = n.targets
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            targets = (n.target,)
+        elif isinstance(n, ast.For):
+            targets = (n.target,)
+        elif isinstance(n, ast.withitem) and n.optional_vars is not None:
+            targets = (n.optional_vars,)
+        for t in targets:
+            for leaf in ast.walk(t):
+                d = dotted(leaf)
+                if d is not None:
+                    out.add(d)
+    return out
+
+
+def kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def decorator_jit_info(
+    deco: ast.AST,
+) -> Optional[Tuple[Tuple[str, ...], Tuple[int, ...]]]:
+    """If ``deco`` is a ``functools.partial(jax.jit, ...)`` or
+    ``jax.jit(...)`` expression, return ``(static_argnames,
+    donate_argnums)`` — empty tuples when the kwarg is absent. ``None``
+    when it is not a jit wrapper at all."""
+    if not isinstance(deco, ast.Call):
+        return None
+    fname = call_name(deco)
+    target = None
+    if fname == "partial" and deco.args:
+        target = dotted(deco.args[0])
+    elif fname == "jit":
+        target = dotted(deco.func)
+    if target not in ("jax.jit", "jit"):
+        return None
+    statics: Tuple[str, ...] = ()
+    donate: Tuple[int, ...] = ()
+    sa = kwarg(deco, "static_argnames")
+    if sa is not None:
+        try:
+            val = ast.literal_eval(sa)
+            statics = (val,) if isinstance(val, str) else tuple(val)
+        except ValueError:
+            pass
+    da = kwarg(deco, "donate_argnums")
+    if da is not None:
+        try:
+            val = ast.literal_eval(da)
+            donate = (val,) if isinstance(val, int) else tuple(val)
+        except ValueError:
+            pass
+    return statics, donate
+
+
+def func_param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    return [p.arg for p in (a.posonlyargs + a.args)]
+
+
+def arg_for_param(
+    call: ast.Call, params: List[str], param: str
+) -> Optional[ast.AST]:
+    """The expression passed for ``param`` at this call site (positional by
+    index, else keyword), or None when not passed / starred."""
+    for kw in call.keywords:
+        if kw.arg == param:
+            return kw.value
+    try:
+        idx = params.index(param)
+    except ValueError:
+        return None
+    if idx < len(call.args):
+        arg = call.args[idx]
+        if isinstance(arg, ast.Starred):
+            return None
+        # a preceding *args makes positional indexes unreliable
+        if any(isinstance(a, ast.Starred) for a in call.args[:idx]):
+            return None
+        return arg
+    return None

@@ -1,0 +1,128 @@
+"""Index of the package's jit-compiled programs: statics + donations.
+
+Both the ``dispatch-statics`` and ``donation-safety`` rules need to know,
+for every jitted serving program, which parameters are compile-time statics
+(``static_argnames``) and which argument positions are donated
+(``donate_argnums``). This module builds that index from the AST — no jax
+import — recognizing the three wrapping idioms the repo uses:
+
+1. decorator:     ``@functools.partial(jax.jit, static_argnames=..., ...)``
+2. assignment:    ``_f_jit = jax.jit(_f_impl, donate_argnums=(0,), ...)``
+                  and ``_f_jit = functools.partial(jax.jit, ...)(_f_impl)``
+3. thin wrapper:  a module-level ``def`` that forwards one of its own
+                  parameters into a donated position of a known jitted
+                  callee (e.g. ``cancel_rows_batched`` → ``serve_cancel_rows``)
+                  — the wrapper inherits that donation.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Tuple
+
+from . import astutil
+from .core import Package
+
+
+@dataclasses.dataclass
+class JitInfo:
+    name: str
+    path: str                  # defining module (repo-relative)
+    line: int
+    params: List[str]          # positional parameter names of the impl
+    statics: Tuple[str, ...]   # static_argnames
+    donated: Tuple[int, ...]   # donated positional indexes
+
+    def donated_params(self) -> List[str]:
+        return [
+            self.params[i] for i in self.donated if i < len(self.params)
+        ]
+
+
+def _impl_params(mod: ast.Module, impl_name: str) -> List[str]:
+    for node in mod.body:
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name == impl_name
+        ):
+            return astutil.func_param_names(node)
+    return []
+
+
+def build(pkg: Package) -> Dict[str, JitInfo]:
+    """name → JitInfo over the whole package. Names are assumed unique
+    across modules (true for this repo's serving programs); on a collision
+    the first definition wins and the rest are ignored."""
+    index: Dict[str, JitInfo] = {}
+    for rel, pf in pkg.files.items():
+        for node in ast.walk(pf.tree):
+            # idiom 1: decorated def
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    info = astutil.decorator_jit_info(deco)
+                    if info is None:
+                        continue
+                    statics, donate = info
+                    index.setdefault(node.name, JitInfo(
+                        node.name, rel, node.lineno,
+                        astutil.func_param_names(node), statics, donate,
+                    ))
+                    break
+            # idiom 2: assignment-wrapped impl
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+            ):
+                target = node.targets[0].id
+                call = node.value
+                impl = None
+                info = astutil.decorator_jit_info(call)
+                if info is not None and call.args:
+                    # jax.jit(_impl, ...)
+                    impl = astutil.dotted(call.args[0])
+                elif (
+                    isinstance(call.func, ast.Call)
+                    and astutil.decorator_jit_info(call.func) is not None
+                    and call.args
+                ):
+                    # functools.partial(jax.jit, ...)(_impl)
+                    info = astutil.decorator_jit_info(call.func)
+                    impl = astutil.dotted(call.args[0])
+                if info is None or impl is None:
+                    continue
+                statics, donate = info
+                index.setdefault(target, JitInfo(
+                    target, rel, node.lineno,
+                    _impl_params(pf.tree, impl), statics, donate,
+                ))
+
+    # idiom 3: one-level thin-wrapper donation propagation
+    for rel, pf in pkg.files.items():
+        for node in pf.tree.body:
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name in index:
+                continue
+            params = astutil.func_param_names(node)
+            inherited: List[int] = []
+            for call in astutil.walk_calls(node):
+                callee = index.get(astutil.call_name(call) or "")
+                if callee is None or not callee.donated:
+                    continue
+                for pos in callee.donated:
+                    if pos >= len(callee.params):
+                        continue
+                    arg = astutil.arg_for_param(
+                        call, callee.params, callee.params[pos]
+                    )
+                    if isinstance(arg, ast.Name) and arg.id in params:
+                        inherited.append(params.index(arg.id))
+            if inherited:
+                index[node.name] = JitInfo(
+                    node.name, rel, node.lineno, params, (),
+                    tuple(sorted(set(inherited))),
+                )
+    return index

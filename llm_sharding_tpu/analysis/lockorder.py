@@ -1,0 +1,234 @@
+"""Runtime lock-order tracker + the repo's canonical lock hierarchy.
+
+The serving stack is heavily threaded (request threads, the step pump, the
+ingress dispatch pump, the autoscaler, the disagg hand-off sidecar, HTTP
+exposition) and has paid for lock-order bugs by hand in three separate PRs.
+This module makes the hierarchy explicit and machine-checked twice over:
+
+- **statically**: ``ORDER`` below is the single source of truth the
+  ``lock-order`` lint rule validates every cross-lock call edge against
+  (``python -m llm_sharding_tpu lint --rule lock-order``);
+- **at runtime**: with ``SHARDLINT_LOCK_ORDER=1`` in the environment,
+  every lock the runtime constructs through :func:`named_lock` becomes a
+  tracking wrapper that raises :class:`LockOrderViolation` — naming BOTH
+  acquisition stacks — the moment a thread acquires a lock that ranks
+  above one it already holds. The chaos suites (``tests/test_resilience``,
+  ``tests/test_disagg``) run under this flag in CI.
+
+Rules of the hierarchy:
+
+- A thread may only acquire locks of **equal or later rank** than every
+  lock it already holds (outer locks first). Equal rank is allowed because
+  dp serving holds several same-named instances (one ``server.mutex`` per
+  replica) under the router lock; the router serializes those, so
+  same-rank acquisition is one-way in practice.
+- Re-acquiring the **same instance** is always fine (``server.mutex`` and
+  ``replica.router`` are RLocks by design).
+- New locks MUST be constructed via :func:`named_lock` with a name listed
+  in ``ORDER`` — a raw ``threading.Lock()`` in a runtime/obs module and an
+  unknown name are both lint findings, so the hierarchy cannot drift
+  silently.
+
+Everything here is stdlib-only and import-cheap: the runtime modules (and
+``obs.metrics``, which must stay importable without jax) call
+:func:`named_lock` at construction time.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import List, Optional, Tuple
+
+#: The canonical acquisition order, OUTERMOST first. Derived from the
+#: static lock-acquisition graph over the runtime/obs modules (see
+#: ``rule_lockorder``) and asserted live by the tracker.
+#:
+#: The shape of the hierarchy: front-door pumps (ingress) sit outside the
+#: control plane (autoscaler, replica router), which sits outside the
+#: per-replica serving mutex; per-subsystem leaves (engine reconfig, fault
+#: plans, fair-queue state) nest inside a server step; observability locks
+#: (trace ring/writer, metric families) are innermost — every subsystem
+#: records telemetry while holding its own lock, and obs never calls back
+#: out.
+ORDER: Tuple[str, ...] = (
+    "ingress.pump_gate",      # pause() gate around a full dispatch pump
+    "ingress.state",          # IngressServer._mutex: live-set + counters
+    "autoscale.controller",   # tick state; holds while spawn/drain/rebal
+    "replica.router",         # ReplicatedServer._lock (RLock)
+    "server.prefetcher",      # _Prefetcher singleton construction
+    "server.mutex",           # PipelineServer._mutex (RLock): step state
+    "disagg.handoff",         # sidecar rendezvous condition (counters only)
+    "engine.reconfig",        # PipelineEngine._lock: placement swap vs use
+    "faults.plan",            # FaultPlan arming/matching
+    "fairness.queue",         # FairQueue state (tenant heaps, service)
+    "fairness.bucket",        # per-tenant TokenBucket (consulted by queue)
+    "obs.trace.ring",         # flight-recorder ring
+    "obs.trace.writer",       # JSONL span writer
+    "obs.metrics.registry",   # family name -> family map
+    "obs.metrics.stategauge", # one-hot flip serialization (then family)
+    "obs.metrics.family",     # every counter/gauge/histogram child
+    "obs.metrics.shape_keys", # jit shape-key seen-set
+)
+
+_RANK = {name: i for i, name in enumerate(ORDER)}
+
+ENV_FLAG = "SHARDLINT_LOCK_ORDER"
+
+#: Tracking enabled? Read once at import (CI lanes export the flag before
+#: pytest starts); tests flip it via :func:`enable` BEFORE constructing the
+#: locks they want tracked — the choice is baked in at construction time.
+_enabled = os.environ.get(ENV_FLAG, "").strip() not in ("", "0", "false")
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(on: bool = True) -> None:
+    """Force tracking on/off for locks constructed AFTER this call."""
+    global _enabled
+    _enabled = bool(on)
+
+
+class LockOrderViolation(AssertionError):
+    """A thread acquired a lock ranking ABOVE one it already holds. The
+    message carries both stacks: where the held (outer-ranked) lock was
+    acquired and where the out-of-order acquisition happened."""
+
+
+class _Tls(threading.local):
+    def __init__(self):
+        # [(tracked_lock, acquisition stack), ...] in acquisition order
+        self.held: List[Tuple[object, str]] = []
+
+
+_tls = _Tls()
+
+
+def held_names() -> List[str]:
+    """Lock names the calling thread currently holds (oldest first) —
+    diagnostic helper for tests and postmortems."""
+    return [t.name for t, _ in _tls.held]
+
+
+def _check(incoming: "_TrackedBase") -> None:
+    for held, held_stack in _tls.held:
+        if held is incoming:
+            return  # re-entrant acquisition of the same instance: fine
+    for held, held_stack in _tls.held:
+        if held.rank > incoming.rank:
+            here = "".join(traceback.format_stack(limit=16)[:-2])
+            raise LockOrderViolation(
+                f"lock order violation: acquiring {incoming.name!r} "
+                f"(rank {incoming.rank}) while holding {held.name!r} "
+                f"(rank {held.rank}) — canonical order is outer-first "
+                f"{ORDER!r}\n\n"
+                f"--- stack that acquired {held.name!r} ---\n{held_stack}\n"
+                f"--- stack acquiring {incoming.name!r} ---\n{here}"
+            )
+
+
+def _push(lock: "_TrackedBase") -> None:
+    _tls.held.append(
+        (lock, "".join(traceback.format_stack(limit=16)[:-3]))
+    )
+
+
+def _pop(lock: "_TrackedBase") -> None:
+    for i in range(len(_tls.held) - 1, -1, -1):
+        if _tls.held[i][0] is lock:
+            del _tls.held[i]
+            return
+
+
+class _TrackedBase:
+    __slots__ = ("name", "rank", "_inner")
+
+    def __init__(self, name: str, inner):
+        self.name = name
+        self.rank = _RANK[name]
+        self._inner = inner
+
+    def acquire(self, *a, **kw) -> bool:
+        _check(self)
+        got = self._inner.acquire(*a, **kw)
+        if got:
+            _push(self)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        _pop(self)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<tracked {self.name} {self._inner!r}>"
+
+
+class TrackedLock(_TrackedBase):
+    pass
+
+
+class TrackedRLock(_TrackedBase):
+    pass
+
+
+class TrackedCondition(_TrackedBase):
+    """Condition wrapper: order-checked at acquisition; ``wait`` releases
+    and re-acquires the SAME instance, which is order-neutral (the thread
+    blocks — it cannot acquire anything else meanwhile), so the held
+    record simply stays for the duration of the ``with`` block."""
+
+    def wait(self, timeout: Optional[float] = None):
+        return self._inner.wait(timeout)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        return self._inner.wait_for(predicate, timeout)
+
+    def notify(self, n: int = 1) -> None:
+        self._inner.notify(n)
+
+    def notify_all(self) -> None:
+        self._inner.notify_all()
+
+
+_KINDS = {
+    "lock": (threading.Lock, TrackedLock),
+    "rlock": (threading.RLock, TrackedRLock),
+    "condition": (threading.Condition, TrackedCondition),
+}
+
+
+def named_lock(name: str, kind: str = "lock"):
+    """Construct a lock registered in the canonical hierarchy.
+
+    Returns a plain ``threading`` primitive when tracking is disabled (the
+    default — zero steady-state overhead) and a tracking wrapper when
+    ``SHARDLINT_LOCK_ORDER=1`` (or :func:`enable`) was set at construction
+    time. ``name`` must appear in ``ORDER``; ``kind`` is one of ``lock`` /
+    ``rlock`` / ``condition``."""
+    if name not in _RANK:
+        raise ValueError(
+            f"lock name {name!r} is not in the canonical ORDER — add it to "
+            f"llm_sharding_tpu/analysis/lockorder.ORDER at its correct rank"
+        )
+    try:
+        plain, tracked = _KINDS[kind]
+    except KeyError:
+        raise ValueError(
+            f"unknown lock kind {kind!r}; one of {sorted(_KINDS)}"
+        ) from None
+    if not _enabled:
+        return plain()
+    return tracked(name, plain())

@@ -1,0 +1,117 @@
+"""dispatch-statics: every static that reaches a jitted program at a
+``record_shape_key`` dispatch site must appear in the recorded shape key.
+
+The bug class (PR 12, found by hand): the interleaved ``serve_chunk``
+inside ``_admit_chunked`` omitted the resolved ``attn`` static, so kernel
+servers silently compiled — and the hit/miss mirror silently misattributed
+— a second xla-only variant. The jit cache keys on EVERY static; a shape
+key that names fewer statics than the dispatch passes lies about compiles.
+
+Mechanics: for each ``record_shape_key("prog", (<key exprs>))`` call, every
+later call to ``prog`` in the same function (including nested closures like
+the ``do_chunk`` retry bodies) is its dispatch. For each static parameter
+of the program (from ``static_argnames`` in the defining module, positional
+or keyword at the call site), the names the argument expression reads must
+be a subset of the names the key tuple reads — ``attn=attn`` is covered by
+a key containing ``attn``; ``block_size=self.kv_block_size or 0`` by
+``self.kv_block_size``. Literal-constant statics need no key entry.
+
+Process-constant plumbing statics (``cfg``, ``mesh``, model forward
+closures) are exempt: they never vary across a server's dispatches, so
+keying on them would only fragment the hit/miss mirror.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from . import astutil, jitindex
+from .core import Finding, Package
+
+RULE = "dispatch-statics"
+DOC = (
+    "statics passed to a jitted program must appear in its recorded "
+    "shape key"
+)
+
+#: Statics that are process-lifetime constants by construction — the same
+#: object for every dispatch a server ever makes — and deliberately kept
+#: out of shape keys.
+EXEMPT_STATICS = frozenset({"cfg", "mesh", "fwd"})
+
+
+def _src(pf, node: ast.AST) -> str:
+    try:
+        return ast.get_source_segment(pf.source, node) or "<expr>"
+    except Exception:  # pragma: no cover - defensive
+        return "<expr>"
+
+
+def check(pkg: Package) -> List[Finding]:
+    jits = jitindex.build(pkg)
+    findings: List[Finding] = []
+    for rel, pf in pkg.files.items():
+        parents = astutil.parent_map(pf.tree)
+        # (enclosing function, program) -> [(record call, key refs)]
+        records = []
+        for call in astutil.walk_calls(pf.tree):
+            if astutil.call_name(call) != "record_shape_key":
+                continue
+            if len(call.args) < 2:
+                continue
+            prog = astutil.literal_str(call.args[0])
+            if prog is None:
+                continue
+            fn = astutil.enclosing_function(call, parents)
+            records.append(
+                (fn, prog, call, astutil.ref_paths(call.args[1]))
+            )
+        if not records:
+            continue
+        for fn, prog, rec, key_refs in records:
+            info = jits.get(prog)
+            if info is None:
+                continue  # program name with no jitted def: out of scope
+            scope = fn if fn is not None else pf.tree
+            # dispatches of this program after this record and before the
+            # NEXT record of the same program in the same function
+            next_lines = sorted(
+                r.lineno for f2, p2, r, _ in records
+                if f2 is fn and p2 == prog and r.lineno > rec.lineno
+            )
+            horizon = next_lines[0] if next_lines else float("inf")
+            for call in astutil.walk_calls(scope):
+                if astutil.call_name(call) != prog:
+                    continue
+                if call is rec or not (
+                    rec.lineno <= call.lineno < horizon
+                ):
+                    continue
+                for static in info.statics:
+                    if static in EXEMPT_STATICS:
+                        continue
+                    arg = astutil.arg_for_param(call, info.params, static)
+                    if arg is None:  # not passed: default applies
+                        continue
+                    if astutil.is_constant_expr(arg):
+                        continue
+                    missing = astutil.ref_paths(arg) - key_refs
+                    if missing:
+                        findings.append(Finding(
+                            rule=RULE, path=rel, line=call.lineno,
+                            message=(
+                                f"dispatch of {prog}() at line "
+                                f"{call.lineno}: static {static!r} = "
+                                f"`{_src(pf, arg)}` is not named in the "
+                                f"shape key recorded at line {rec.lineno} "
+                                f"(missing refs: {sorted(missing)}) — the "
+                                f"jit cache keys on it, so the hit/miss "
+                                f"mirror will misattribute compiles"
+                            ),
+                            key=(
+                                f"{getattr(fn, 'name', '<module>')}:"
+                                f"{prog}:{static}"
+                            ),
+                        ))
+    return findings

@@ -1,0 +1,248 @@
+"""donation-safety: donated buffers must be dead after the dispatch, and
+retry wrappers around donating dispatches must not re-run on real errors.
+
+``donate_argnums`` hands a buffer's HBM to XLA: after the dispatch the
+caller's array is invalid, and touching it raises (at best) a
+``RuntimeError: invalid buffer`` or (at worst, across transfers) reads
+garbage. Two checks, both grounded in hand-caught bugs from PRs 4/7/12:
+
+1. **read-after-donation** — at every call site of a donating program, the
+   expression passed at a donated position (``self.state``,
+   ``self.state.k``, ...) must not be read later in the same function
+   unless the path — or a prefix of it, e.g. reassigning the whole
+   ``self.state`` — was reassigned first. The idiomatic safe shape is
+   ``self.state, log = serve_chunk(..., self.state, ...)``: the same
+   statement that donates also rebinds.
+
+2. **retry real_ok=False** — a ``self._retry(site, fn)`` whose ``fn``
+   dispatches a donating program may only retry INJECTED faults (which
+   raise before the dispatch runs). A real failure may have already
+   consumed the donated buffer, so re-running ``fn`` replays a dispatch
+   whose input no longer exists; such wrappers must pass
+   ``real_ok=False``.
+
+The lexical read-after analysis is per-function and line-ordered; donated
+arguments that are fresh temporaries (call results, literals) are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from . import astutil, jitindex
+from .core import Finding, Package
+
+RULE = "donation-safety"
+DOC = (
+    "no reads of donated buffers after dispatch; donating retries are "
+    "real_ok=False"
+)
+
+
+def _loads_and_stores(
+    fn: ast.AST,
+) -> Tuple[List[Tuple[str, int]], List[Tuple[str, int]]]:
+    """All dotted-path (path, line) loads and stores in ``fn``, skipping
+    nested function bodies is NOT done — closures dispatch and read too."""
+    loads: List[Tuple[str, int]] = []
+    stores: List[Tuple[str, int]] = []
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Attribute, ast.Name)):
+            d = astutil.dotted(node)
+            if d is None:
+                continue
+            if isinstance(getattr(node, "ctx", None), ast.Store):
+                stores.append((d, node.lineno))
+            elif isinstance(getattr(node, "ctx", None), ast.Load):
+                loads.append((d, node.lineno))
+    return loads, stores
+
+
+def _is_dead_after(
+    path: str, call_line: int, call_end: int, loads, stores, sub_spans,
+    barriers,
+) -> Optional[int]:
+    """Line of the first live read of ``path`` (or an extension of it)
+    after the dispatch with no intervening store to the path or a prefix
+    of it; None when the buffer is provably (lexically) dead.
+
+    Stores from ``call_line`` on count as kills — the idiomatic
+    ``self.state, log = serve_chunk(..., self.state, ...)`` rebinds on the
+    dispatch's own (multi-line) statement. ``barriers`` are lines of
+    return/raise statements that terminate the dispatch's own block:
+    nothing after them is reachable from this dispatch, so later reads
+    belong to the branch that did NOT donate. ``sub_spans`` are (start,
+    end) line spans of OTHER nested functions whose loads don't belong to
+    this flow."""
+    prefixes = []
+    parts = path.split(".")
+    for i in range(1, len(parts) + 1):
+        prefixes.append(".".join(parts[:i]))
+    kills = sorted(
+        [ln for p, ln in stores if p in prefixes and ln >= call_line]
+        + [b for b in barriers if b >= call_end]
+    )
+    for p, ln in sorted(loads, key=lambda t: t[1]):
+        if ln <= call_end:
+            continue
+        if any(s <= ln for s in kills):
+            break  # rebound, or unreachable from this dispatch
+        if any(a <= ln <= b for a, b in sub_spans):
+            continue
+        if p == path or p.startswith(path + "."):
+            return ln
+    return None
+
+
+def _innermost_block(scope: ast.AST, call: ast.Call) -> Optional[list]:
+    """The statement list most tightly containing ``call`` (walking If /
+    loop / try bodies), so sibling return/raise barriers can be found."""
+    best: Optional[list] = None
+    span = -1
+
+    def visit(stmts: list):
+        nonlocal best, span
+        lo = stmts[0].lineno
+        hi = max(s.end_lineno or s.lineno for s in stmts)
+        if not (lo <= call.lineno <= hi):
+            return
+        if best is None or (hi - lo) <= span or span < 0:
+            best, span = stmts, hi - lo
+        for s in stmts:
+            for field in (
+                "body", "orelse", "finalbody", "handlers",
+            ):
+                sub = getattr(s, field, None)
+                if not sub:
+                    continue
+                if field == "handlers":
+                    for h in sub:
+                        if h.body:
+                            visit(h.body)
+                elif isinstance(sub, list) and sub and isinstance(
+                    sub[0], ast.stmt
+                ):
+                    visit(sub)
+
+    body = getattr(scope, "body", None)
+    if body:
+        visit(body)
+    return best
+
+
+def check(pkg: Package) -> List[Finding]:
+    jits = jitindex.build(pkg)
+    donating = {n: i for n, i in jits.items() if i.donated}
+    findings: List[Finding] = []
+    for rel, pf in pkg.files.items():
+        parents = astutil.parent_map(pf.tree)
+        for call in astutil.walk_calls(pf.tree):
+            name = astutil.call_name(call)
+
+            # -- check 2: retry wrappers around donating dispatches ------
+            if name == "_retry" and len(call.args) >= 2:
+                fn_arg = call.args[1]
+                body: Optional[ast.AST] = None
+                if isinstance(fn_arg, ast.Lambda):
+                    body = fn_arg.body
+                elif isinstance(fn_arg, ast.Name):
+                    scope = astutil.enclosing_function(call, parents)
+                    if scope is not None:
+                        for n in ast.walk(scope):
+                            if (
+                                isinstance(n, ast.FunctionDef)
+                                and n.name == fn_arg.id
+                            ):
+                                body = n
+                                break
+                if body is not None and any(
+                    astutil.call_name(c) in donating
+                    for c in astutil.walk_calls(body)
+                ):
+                    ro = astutil.kwarg(call, "real_ok")
+                    if not (
+                        isinstance(ro, ast.Constant) and ro.value is False
+                    ):
+                        site = astutil.literal_str(call.args[0]) or "?"
+                        findings.append(Finding(
+                            rule=RULE, path=rel, line=call.lineno,
+                            message=(
+                                f"_retry({site!r}, ...) wraps a dispatch "
+                                f"that donates its input buffers but does "
+                                f"not pass real_ok=False — a real failure "
+                                f"may already have consumed the donation, "
+                                f"so the retry would replay a dispatch "
+                                f"whose input no longer exists"
+                            ),
+                            key=f"retry:{site}",
+                        ))
+                continue
+
+            # -- check 1: read-after-donation ----------------------------
+            info = donating.get(name or "")
+            if info is None:
+                continue
+            scope = astutil.enclosing_function(call, parents)
+            if scope is None:
+                continue
+            # nested defs that do NOT contain this call: their loads run
+            # at an unrelated time, not lexically after this dispatch
+            sub_spans = [
+                (n.lineno, n.end_lineno or n.lineno)
+                for n in ast.walk(scope)
+                if isinstance(n, (ast.FunctionDef, ast.Lambda))
+                and n is not scope
+                and not (
+                    n.lineno <= call.lineno <= (n.end_lineno or n.lineno)
+                )
+            ]
+            # return/raise statements in the block stack enclosing the
+            # dispatch: control cannot flow past them to later lines
+            barriers = []
+            block = _innermost_block(scope, call)
+            if block is not None:
+                for stmt in block:
+                    if (
+                        isinstance(stmt, (ast.Return, ast.Raise))
+                        and stmt.lineno >= call.lineno
+                    ):
+                        # control cannot flow PAST the return/raise; its
+                        # own expression still executes, so the barrier
+                        # starts on the next line
+                        barriers.append(
+                            (stmt.end_lineno or stmt.lineno) + 1
+                        )
+            loads, stores = _loads_and_stores(scope)
+            for pos in info.donated:
+                if pos >= len(info.params):
+                    continue
+                arg = astutil.arg_for_param(
+                    call, info.params, info.params[pos]
+                )
+                if arg is None:
+                    continue
+                path = astutil.dotted(arg)
+                if path is None:
+                    continue  # fresh temporary (call result / literal)
+                read_at = _is_dead_after(
+                    path, call.lineno, call.end_lineno or call.lineno,
+                    loads, stores, sub_spans, barriers,
+                )
+                if read_at is not None:
+                    findings.append(Finding(
+                        rule=RULE, path=rel, line=call.lineno,
+                        message=(
+                            f"`{path}` is donated to {name}() (param "
+                            f"{info.params[pos]!r}) at line {call.lineno} "
+                            f"but read again at line {read_at} without "
+                            f"being reassigned — the buffer is invalid "
+                            f"after the dispatch"
+                        ),
+                        key=(
+                            f"{getattr(scope, 'name', '<module>')}:"
+                            f"{name}:{path}"
+                        ),
+                    ))
+    return findings

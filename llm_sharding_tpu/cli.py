@@ -1256,6 +1256,19 @@ def cmd_trace_report(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """shardlint: the repo-native static-analysis pass (jax-free — see
+    ``analysis/``). Exits nonzero on findings not in the baseline."""
+    from .analysis.core import run_lint
+
+    return run_lint(
+        only=args.rule or None,
+        baseline_path=args.baseline,
+        as_json=args.json,
+        write_baseline=args.write_baseline,
+    )
+
+
 def cmd_bench(args) -> int:
     import importlib.util
     import os
@@ -1684,6 +1697,37 @@ def build_parser() -> argparse.ArgumentParser:
         help="machine-readable report (one JSON object)",
     )
     tr.set_defaults(fn=cmd_trace_report)
+
+    li = sub.add_parser(
+        "lint",
+        help="shardlint: repo-native static analysis (dispatch/shape-key "
+        "completeness, donation safety, lock order, metrics/trace "
+        "discipline); exits nonzero on new findings",
+    )
+    li.add_argument(
+        "--rule", action="append", default=None,
+        metavar="RULE",
+        help="run only this rule (repeatable): dispatch-statics, "
+        "donation-safety, lock-order, metrics-discipline, "
+        "trace-discipline",
+    )
+    li.add_argument(
+        "--json", action="store_true",
+        help="machine-readable report (one JSON object)",
+    )
+    li.add_argument(
+        "--baseline", default=None,
+        help="baseline file of known finding fingerprints (default: "
+        "llm_sharding_tpu/analysis/baseline.json — committed empty; the "
+        "gate is strict)",
+    )
+    li.add_argument(
+        "--write-baseline", action="store_true",
+        help="regenerate the baseline from the current findings instead "
+        "of failing on them (escape hatch — the intended state is an "
+        "empty baseline)",
+    )
+    li.set_defaults(fn=cmd_lint)
     return p
 
 
@@ -1704,9 +1748,9 @@ def main(argv=None) -> int:
     # initializes the backend in-process anyway, so the authoritative
     # jax.devices() probe is safe; `worker` must not touch the backend
     # before jax.distributed.initialize, so it falls back to the env var.
-    if args.command == "trace-report":
-        # pure file analysis — no backend, no compile cache, runs on hosts
-        # with no accelerator stack at all
+    if args.command in ("trace-report", "lint"):
+        # pure file analysis — no backend, no compile cache, no jax
+        # import at all; runs on hosts with no accelerator stack
         return args.fn(args)
     if args.command == "worker":
         on_cpu = (

@@ -26,6 +26,8 @@ import threading
 import time
 from typing import Callable, Dict, Optional, Sequence, Tuple
 
+from ..analysis.lockorder import named_lock
+
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 
@@ -87,7 +89,7 @@ class _CounterChild:
     __slots__ = ("_lock", "_value")
 
     def __init__(self, lock: threading.Lock):
-        self._lock = lock
+        self._lock = lock  # shardlint: lock obs.metrics.family
         self._value = 0.0
 
     def inc(self, n: float = 1.0) -> None:
@@ -106,7 +108,7 @@ class _GaugeChild:
     __slots__ = ("_lock", "_value")
 
     def __init__(self, lock: threading.Lock):
-        self._lock = lock
+        self._lock = lock  # shardlint: lock obs.metrics.family
         self._value = 0.0
 
     def set(self, v: float) -> None:
@@ -130,7 +132,7 @@ class _HistogramChild:
     __slots__ = ("_lock", "bounds", "counts", "sum", "count", "exemplars")
 
     def __init__(self, lock: threading.Lock, bounds: Tuple[float, ...]):
-        self._lock = lock
+        self._lock = lock  # shardlint: lock obs.metrics.family
         self.bounds = bounds
         self.counts = [0] * (len(bounds) + 1)  # +1: the +Inf bucket
         self.sum = 0.0
@@ -223,7 +225,7 @@ class _Family:
         self.help = help
         self.label_names = label_names
         self.buckets = buckets
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.metrics.family")
         self._children: Dict[Tuple[str, ...], object] = {}
         if not label_names:
             self._children[()] = self._make_child()
@@ -292,7 +294,7 @@ class StateGauge:
         self._family = family
         self.states = states
         self._state: Optional[str] = None
-        self._set_lock = threading.Lock()
+        self._set_lock = named_lock("obs.metrics.stategauge")
         for s in states:  # materialize every label so scrapes see the 0s
             family.labels(state=s).set(0.0)
 
@@ -318,7 +320,7 @@ class Registry:
     a conflicting re-registration raises."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.metrics.registry")
         self._families: Dict[str, _Family] = {}
 
     def _register(self, kind, name, help, labels, buckets=None) -> _Family:
@@ -803,7 +805,7 @@ AUTOSCALE_LOAD = REGISTRY.gauge(
 # -- compile/shape-key visibility -----------------------------------------
 
 _SHAPE_KEYS_SEEN: set = set()
-_SHAPE_KEYS_LOCK = threading.Lock()
+_SHAPE_KEYS_LOCK = named_lock("obs.metrics.shape_keys")
 _SHAPE_KEYS = REGISTRY.counter(
     "engine_jit_shape_keys_total",
     "Host-side mirror of the jit program cache: first sight of a "

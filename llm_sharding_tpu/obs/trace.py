@@ -52,6 +52,8 @@ import os
 import re
 import threading
 import time
+
+from ..analysis.lockorder import named_lock
 from typing import Optional
 
 #: Rollover threshold for ``TraceWriter`` (bytes). At ~150 B/span this keeps
@@ -126,7 +128,7 @@ class SpanRing:
     (bench ``serve_trace_overhead_*``)."""
 
     def __init__(self, capacity: int = 4096):
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.trace.ring")
         self._ring: collections.deque = collections.deque(maxlen=capacity)
         self._enabled = True
 
@@ -172,7 +174,7 @@ class TraceWriter:
     def __init__(self, path: str, max_bytes: int = DEFAULT_TRACE_MAX_BYTES):
         self.path = path
         self.max_bytes = int(max_bytes)
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.trace.writer")
         self._f = open(path, "a", buffering=1)
         try:
             self._written = os.fstat(self._f.fileno()).st_size

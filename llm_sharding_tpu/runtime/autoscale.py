@@ -35,6 +35,7 @@ from ..obs.metrics import (
     AUTOSCALE_DRAINS, AUTOSCALE_LOAD, AUTOSCALE_REPLICAS, AUTOSCALE_SPAWNS,
 )
 from ..obs.trace import emit_span
+from ..analysis.lockorder import named_lock
 
 logger = logging.getLogger("llm_sharding_tpu.autoscale")
 
@@ -97,7 +98,7 @@ class Autoscaler:
         self._high_since: Optional[float] = None
         self._low_since: Optional[float] = None
         self._cooldown_until = -float("inf")
-        self._lock = threading.Lock()
+        self._lock = named_lock("autoscale.controller")
         self.spawns = 0
         self.drains = 0
         # paced auto-rebalance (ROADMAP item 1d): every rebalance_every_s

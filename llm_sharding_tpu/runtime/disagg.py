@@ -65,6 +65,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..analysis.lockorder import named_lock
 from ..obs.metrics import (
     DISAGG_HANDOFFS, DISAGG_TTFT_ERROR, HANDOFF_BYTES, REPLICA_ROLES,
     REPLICA_SPAWNS, set_replica_role,
@@ -177,7 +178,7 @@ class DisaggServer(ReplicatedServer):
         self._handoff_jobs: "queue.Queue" = queue.Queue()
         self._handoff_thread: Optional[threading.Thread] = None
         self._handoff_inflight = 0
-        self._handoff_cv = threading.Condition()
+        self._handoff_cv = named_lock("disagg.handoff", "condition")
         self._handoff_stop = False  # close(): fail queued jobs typed
         # requests awaiting their prefill→decode hand-off (Request →
         # transient-fault attempt count); entries drop when the request

@@ -48,6 +48,7 @@ import jax.numpy as jnp
 
 from ..models.config import ModelConfig
 from ..obs.metrics import REGISTRY, record_shape_key
+from ..analysis.lockorder import named_lock
 from ..parallel.mesh import PIPE_AXIS, pipeline_mesh
 from ..parallel.pipeline import PipelineResult, pipeline_generate
 from ..parallel.placement import PlacementSpec, stack_stage_params
@@ -121,7 +122,7 @@ class PipelineEngine:
             }
         self.tokenizer = tokenizer
         self.cache_dtype = cache_dtype
-        self._lock = threading.Lock()
+        self._lock = named_lock("engine.reconfig")
         self.data_parallel = int(data_parallel)
         self.tensor_parallel = int(tensor_parallel)
         if self.data_parallel < 1 or self.tensor_parallel < 1:

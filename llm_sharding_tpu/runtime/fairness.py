@@ -34,6 +34,7 @@ from collections import deque
 from typing import Callable, Dict, Iterable, Optional, Tuple
 
 from ..obs.metrics import TENANT_QUEUED, TENANT_SERVICE, TENANT_THROTTLED
+from ..analysis.lockorder import named_lock
 
 
 class RateLimited(RuntimeError):
@@ -101,7 +102,7 @@ class TokenBucket:
         self._clock = clock
         self._tokens = float(burst)
         self._at = clock()
-        self._lock = threading.Lock()
+        self._lock = named_lock("fairness.bucket")
 
     def _refill(self, now: float) -> None:
         if now > self._at:
@@ -252,7 +253,7 @@ class FairQueue:
         clock: Callable[[], float] = time.monotonic,
     ):
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = named_lock("fairness.queue")
         self._t: Dict[str, _TenantState] = {}
         self.allow_anonymous = bool(allow_anonymous)
         self._by_key: Dict[str, str] = {}

@@ -32,6 +32,7 @@ from typing import Iterable, Optional, Sequence, Tuple
 import numpy as np
 
 from ..obs.metrics import REGISTRY
+from ..analysis.lockorder import named_lock
 
 M_FAULTS_INJECTED = REGISTRY.counter(
     "server_faults_injected_total",
@@ -171,7 +172,7 @@ class FaultPlan:
         ]
         self._calls: collections.Counter = collections.Counter()
         self._fires: collections.Counter = collections.Counter()
-        self._lock = threading.Lock()
+        self._lock = named_lock("faults.plan")
 
     # ------------------------------------------------------------ builders
 

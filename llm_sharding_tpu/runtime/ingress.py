@@ -54,6 +54,7 @@ from ..obs.metrics import (
     INGRESS_ACTIVE, INGRESS_QUEUED, INGRESS_REQUESTS, INGRESS_TTFT,
 )
 from ..obs.trace import TraceContext, TraceWriter, emit_span
+from ..analysis.lockorder import named_lock
 from .fairness import (
     FairQueue, GlobalQueueFull, RateLimited, TenantConfig, TenantQueueFull,
     UnknownTenant, load_tenants_config,
@@ -192,7 +193,7 @@ class IngressServer:
             raise ValueError(
                 f"dispatch_depth must be >= 1, got {self.dispatch_depth}"
             )
-        self._mutex = threading.Lock()
+        self._mutex = named_lock("ingress.state")
         self._live: list[_Pending] = []
         # entries currently BETWEEN the fair queue and _live (popped, being
         # submitted): wait_idle counts them so the idle verdict can never
@@ -202,7 +203,7 @@ class IngressServer:
         self._paused = False
         # held by the pump for each whole iteration; pause() acquires it
         # once so "paused" means "and the in-flight iteration has finished"
-        self._pump_gate = threading.Lock()
+        self._pump_gate = named_lock("ingress.pump_gate")
         self._stop = False
         self._next_rid = 0
         self._httpd = ThreadingHTTPServer((host, port), self._handler_class())
@@ -497,7 +498,7 @@ class IngressServer:
         lock = self._lock_for(req)
         if lock is None:
             return list(req.tokens[idx:]), req.done, req.error
-        with lock:
+        with lock:  # shardlint: lock server.mutex
             return list(req.tokens[idx:]), req.done, req.error
 
     # ------------------------------------------------------------ handler

@@ -69,6 +69,7 @@ from ..obs.metrics import (
     set_replica_state,
 )
 from ..obs.trace import TraceWriter, emit_span
+from ..analysis.lockorder import named_lock
 from ..parallel.placement import PlacementSpec
 
 from .engine import PipelineEngine
@@ -195,7 +196,7 @@ class ReplicatedServer:
         # one lock serializes router mutations (routing tables, ownership,
         # the servers list) against each other — a cancel can never observe
         # a request mid-migration. Re-entrant: stream() → step() → failover.
-        self._lock = threading.RLock()
+        self._lock = named_lock("replica.router", "rlock")
         # live replicated prefix handles: migration re-resolves a request's
         # source-local handle to the target's through these (weak: handles
         # die with their callers)

@@ -81,6 +81,7 @@ from ..obs.metrics import (
     record_shape_key, set_prefill_path,
 )
 from ..obs.trace import TraceContext, TraceWriter, emit_span
+from ..analysis.lockorder import named_lock
 from ..parallel import serve as serve_ops
 from ..parallel.mesh import PIPE_AXIS
 from .faults import backoff_delays, is_transient
@@ -417,7 +418,7 @@ class _Prefetcher:
     rebuild."""
 
     _instance: Optional["_Prefetcher"] = None
-    _instance_lock = threading.Lock()
+    _instance_lock = named_lock("server.prefetcher")
 
     def __init__(self):
         self._q: queue.Queue = queue.Queue()
@@ -1249,7 +1250,7 @@ class PipelineServer:
         # drives step) get a consistent queue/rows/state view, and a cancel
         # can never interleave with a mid-chunked admission (ADVICE r3 #4).
         # Re-entrant because stream() → step() runs under the same lock.
-        self._mutex = threading.RLock()
+        self._mutex = named_lock("server.mutex", "rlock")
         # register LAST: a concurrent gauge sweep from another serving
         # thread must never see a half-constructed server (_alloc,
         # _mirror_len, _queue, _rows are all read by _update_load_gauges)
@@ -1435,7 +1436,8 @@ class PipelineServer:
         buf = np.zeros((1, spx), np.int32)
         buf[0, :n] = prefix
         record_shape_key(
-            "prefix_prefill", (self.num_stages, spx, self.tp)
+            "prefix_prefill",
+            (self.num_stages, spx, self.tp, self.engine.cache_dtype),
         )
         kv = serve_ops.prefix_prefill(
             self.cfg,
@@ -3516,7 +3518,7 @@ class PipelineServer:
                     (self.num_stages, Bs, self.capacity, bucket, is_emb,
                      spx_key, self._filtering,
                      self.tp, self.kv_block_size, carried, self.kv_dtype,
-                     in_arena),
+                     in_arena, self.engine.cache_dtype),
                 )
                 self.state, tok0 = serve_ops.serve_admit(
                     self.cfg,
@@ -3644,7 +3646,8 @@ class PipelineServer:
         record_shape_key(
             "serve_prefill_chunk",
             (self.num_stages, Bs, self.capacity, Sc, self.tp,
-             self.kv_block_size, attn, self.kv_dtype),
+             self.kv_block_size, attn, self.kv_dtype,
+             self.engine.cache_dtype),
         )
         n_valid = int(row_valid.sum())
         for ci, off in enumerate(range(0, bucket, Sc)):
