@@ -29,6 +29,14 @@ Rules of the hierarchy:
   unknown name are both lint findings, so the hierarchy cannot drift
   silently.
 
+A second opt-in mode rides the same factory: with ``STEPLINE_LOCK_TIMING=1``
+(or :func:`enable_timing`) set at construction time, every named lock also
+times how long ``acquire`` blocked, accumulating per-name totals
+(:func:`wait_totals`) and feeding an optional sink (:func:`set_wait_sink` —
+``obs.stepline`` installs one that observes
+``server_lock_wait_seconds{lock}``). Like order tracking, the default is a
+plain primitive with zero steady-state overhead.
+
 Everything here is stdlib-only and import-cheap: the runtime modules (and
 ``obs.metrics``, which must stay importable without jax) call
 :func:`named_lock` at construction time.
@@ -38,8 +46,9 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 import traceback
-from typing import List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 #: The canonical acquisition order, OUTERMOST first. Derived from the
 #: static lock-acquisition graph over the runtime/obs modules (see
@@ -66,6 +75,7 @@ ORDER: Tuple[str, ...] = (
     "fairness.bucket",        # per-tenant TokenBucket (consulted by queue)
     "obs.trace.ring",         # flight-recorder ring
     "obs.trace.writer",       # JSONL span writer
+    "obs.stepline.ring",      # step-profiler record ring
     "obs.metrics.registry",   # family name -> family map
     "obs.metrics.stategauge", # one-hot flip serialization (then family)
     "obs.metrics.family",     # every counter/gauge/histogram child
@@ -90,6 +100,67 @@ def enable(on: bool = True) -> None:
     """Force tracking on/off for locks constructed AFTER this call."""
     global _enabled
     _enabled = bool(on)
+
+
+TIMING_ENV_FLAG = "STEPLINE_LOCK_TIMING"
+
+#: Lock-wait timing enabled? Same construction-time semantics as ``_enabled``
+#: above: read once at import, flipped by :func:`enable_timing` for locks
+#: constructed afterwards.
+_timing_enabled = (
+    os.environ.get(TIMING_ENV_FLAG, "").strip() not in ("", "0", "false")
+)
+
+#: name -> [acquire_count, total_blocked_seconds]; guarded by ``_waits_mu``.
+#: A plain lock is fine here: analysis/ sits outside the runtime hierarchy
+#: and this is a leaf no callback ever re-enters.
+_WAITS: Dict[str, List[float]] = {}
+_waits_mu = threading.Lock()
+
+#: Optional per-wait callback ``fn(name, blocked_seconds)``, called OUTSIDE
+#: ``_waits_mu`` after each timed acquire.
+_SINK: Optional[Callable[[str, float], None]] = None
+
+
+def timing_enabled() -> bool:
+    return _timing_enabled
+
+
+def enable_timing(on: bool = True) -> None:
+    """Force lock-wait timing on/off for locks constructed AFTER this."""
+    global _timing_enabled
+    _timing_enabled = bool(on)
+
+
+def set_wait_sink(fn: Optional[Callable[[str, float], None]]) -> None:
+    """Install (or clear) the per-wait callback. One sink, process-wide."""
+    global _SINK
+    _SINK = fn
+
+
+def wait_totals() -> Dict[str, Tuple[int, float]]:
+    """Snapshot of ``{name: (acquire_count, total_blocked_seconds)}`` since
+    process start (or :func:`reset_wait_totals`). Deep captures diff two
+    snapshots to attribute lock waits to a step window."""
+    with _waits_mu:
+        return {k: (int(v[0]), float(v[1])) for k, v in _WAITS.items()}
+
+
+def reset_wait_totals() -> None:
+    with _waits_mu:
+        _WAITS.clear()
+
+
+def _record_wait(name: str, dt: float) -> None:
+    with _waits_mu:
+        ent = _WAITS.get(name)
+        if ent is None:
+            _WAITS[name] = ent = [0, 0.0]
+        ent[0] += 1
+        ent[1] += dt
+    sink = _SINK
+    if sink is not None:
+        sink(name, dt)
 
 
 class LockOrderViolation(AssertionError):
@@ -203,20 +274,81 @@ class TrackedCondition(_TrackedBase):
         self._inner.notify_all()
 
 
+class _TimedBase:
+    """Times how long ``acquire`` blocked; wraps the plain primitive (or the
+    tracking wrapper when both modes are on) and forwards everything else."""
+
+    __slots__ = ("name", "_inner")
+
+    def __init__(self, name: str, inner):
+        self.name = name
+        self._inner = inner
+
+    def acquire(self, *a, **kw) -> bool:
+        t0 = time.perf_counter()
+        got = self._inner.acquire(*a, **kw)
+        _record_wait(self.name, time.perf_counter() - t0)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<timed {self.name} {self._inner!r}>"
+
+
+class TimedLock(_TimedBase):
+    pass
+
+
+class TimedRLock(_TimedBase):
+    pass
+
+
+class TimedCondition(_TimedBase):
+    """``wait`` re-acquires the same instance after being notified; that
+    wake-up contention is part of the condition's own protocol, not step
+    work blocked on the lock, so only entry ``acquire`` is timed."""
+
+    def wait(self, timeout: Optional[float] = None):
+        return self._inner.wait(timeout)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        return self._inner.wait_for(predicate, timeout)
+
+    def notify(self, n: int = 1) -> None:
+        self._inner.notify(n)
+
+    def notify_all(self) -> None:
+        self._inner.notify_all()
+
+
 _KINDS = {
-    "lock": (threading.Lock, TrackedLock),
-    "rlock": (threading.RLock, TrackedRLock),
-    "condition": (threading.Condition, TrackedCondition),
+    "lock": (threading.Lock, TrackedLock, TimedLock),
+    "rlock": (threading.RLock, TrackedRLock, TimedRLock),
+    "condition": (threading.Condition, TrackedCondition, TimedCondition),
 }
 
 
 def named_lock(name: str, kind: str = "lock"):
     """Construct a lock registered in the canonical hierarchy.
 
-    Returns a plain ``threading`` primitive when tracking is disabled (the
-    default — zero steady-state overhead) and a tracking wrapper when
+    Returns a plain ``threading`` primitive when both opt-in modes are off
+    (the default — zero steady-state overhead); a tracking wrapper when
     ``SHARDLINT_LOCK_ORDER=1`` (or :func:`enable`) was set at construction
-    time. ``name`` must appear in ``ORDER``; ``kind`` is one of ``lock`` /
+    time; a wait-timing wrapper when ``STEPLINE_LOCK_TIMING=1`` (or
+    :func:`enable_timing`) was — composed outside the tracker when both are
+    on. ``name`` must appear in ``ORDER``; ``kind`` is one of ``lock`` /
     ``rlock`` / ``condition``."""
     if name not in _RANK:
         raise ValueError(
@@ -224,11 +356,12 @@ def named_lock(name: str, kind: str = "lock"):
             f"llm_sharding_tpu/analysis/lockorder.ORDER at its correct rank"
         )
     try:
-        plain, tracked = _KINDS[kind]
+        plain, tracked, timed = _KINDS[kind]
     except KeyError:
         raise ValueError(
             f"unknown lock kind {kind!r}; one of {sorted(_KINDS)}"
         ) from None
-    if not _enabled:
-        return plain()
-    return tracked(name, plain())
+    lock = plain() if not _enabled else tracked(name, plain())
+    if _timing_enabled:
+        lock = timed(name, lock)
+    return lock

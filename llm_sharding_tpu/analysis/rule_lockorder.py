@@ -56,6 +56,7 @@ SCOPE = (
     "llm_sharding_tpu/runtime/engine.py",
     "llm_sharding_tpu/obs/metrics.py",
     "llm_sharding_tpu/obs/trace.py",
+    "llm_sharding_tpu/obs/stepline.py",
 )
 
 #: Constructor-injected collaborators whose class the AST cannot see.

@@ -191,6 +191,11 @@ def _serve_control(eng, srv, line: str, args):
       the stdin twin of the ``--metrics-port`` HTTP ``/statz`` endpoint
     - ``:snapshot DIR``       — checkpoint the live daemon (device state +
       in-flight/queued requests) to DIR; ``serve --restore DIR`` resumes it
+    - ``:profile N [DIR]``    — arm an N-step deep capture on the step
+      profiler (sub-phase timeline, lock waits, trace_id exemplars; with
+      DIR also a ``jax.profiler`` device trace) and print the JSON bundle —
+      the stdin twin of HTTP ``/profilez?steps=N``. Prints a partial
+      bundle (``complete: false``) if the loop idles before N steps.
 
     Returns the (possibly new) server.
     """
@@ -206,12 +211,28 @@ def _serve_control(eng, srv, line: str, args):
         stats = {
             "counters": srv.counters.snapshot(),
             "metrics": REGISTRY.json_snapshot(),
+            # step-profiler aggregates: host occupancy, p50 step wall
+            "stepline": srv.stepline_stats(),
         }
         pc = srv.prefix_cache_stats()
         if pc is not None:
             # hit rate + tier occupancy for the operator tuning the cache
             stats["prefix_cache"] = pc
         print(json.dumps(stats, sort_keys=True), file=sys.stderr)
+        return srv
+    if cmd == ":profile":
+        sub = parts[1].split() if len(parts) > 1 else []
+        if not sub:
+            print("usage: :profile N [TRACE_DIR]", file=sys.stderr)
+            return srv
+        try:
+            bundle = srv.stepline_capture(
+                int(sub[0]), trace_dir=sub[1] if len(sub) > 1 else None
+            )
+        except ValueError as e:
+            print(f"profile failed: {e}", file=sys.stderr)
+            return srv
+        print(json.dumps(bundle, sort_keys=True), file=sys.stderr)
         return srv
     if cmd == ":snapshot":
         if len(parts) < 2:
@@ -277,6 +298,7 @@ def _serve_control(eng, srv, line: str, args):
                 host_pool_blocks=(
                     srv.host_pool_blocks if srv.prefix_cache == "host" else 0
                 ),
+                gauge_sweep_every_s=srv.gauge_sweep_every_s,
             )
 
         try:
@@ -316,7 +338,7 @@ def _serve_control(eng, srv, line: str, args):
         )
         return new_srv
     print(f"unknown control line {cmd!r} (try :placement, :counters, "
-          ":snapshot)",
+          ":stats, :snapshot, :profile)",
           file=sys.stderr)
     return srv
 
@@ -331,7 +353,10 @@ def _dp_serve_control(srv, line: str):
     - ``:spawn``     — bring a fresh replica up on the lowest freed device
       group (weights re-staged from the shared host arrays).
     - ``:counters`` / ``:stats`` — as on the single-engine daemon, plus
-      per-replica health/load/KV entries.
+      per-replica health/load/KV entries (with each replica's
+      ``host_occupancy`` and ``step_wall_p50_ms``).
+    - ``:profile N [DIR]`` — deep-capture fan-out: arm N steps on EVERY
+      replica's step profiler, print ``{"r<d>": bundle}`` as JSON.
 
     Returns the server (the dp router object is never swapped)."""
     from .obs.metrics import REGISTRY
@@ -374,10 +399,23 @@ def _dp_serve_control(srv, line: str):
             )
         except (ValueError, RuntimeError) as e:
             print(f"spawn failed: {e}", file=sys.stderr)
+    elif cmd == ":profile":
+        sub = parts[1].split() if len(parts) > 1 else []
+        if not sub:
+            print("usage: :profile N [TRACE_DIR]", file=sys.stderr)
+            return srv
+        try:
+            bundle = srv.stepline_capture(
+                int(sub[0]), trace_dir=sub[1] if len(sub) > 1 else None
+            )
+        except ValueError as e:
+            print(f"profile failed: {e}", file=sys.stderr)
+            return srv
+        print(json.dumps(bundle, sort_keys=True), file=sys.stderr)
     else:
         print(
             f"unknown control line {cmd!r} (dp daemon: :drain N, :spawn, "
-            ":counters, :stats)",
+            ":counters, :stats, :profile)",
             file=sys.stderr,
         )
     return srv
@@ -549,6 +587,18 @@ def cmd_serve(args) -> int:
         except (OSError, ValueError, TypeError, KeyError) as e:
             print(f"error: bad --tenants-config: {e}", file=sys.stderr)
             return 2
+    # -- graceful SIGTERM: DRAINING -> finish in-flight -> exit 0 ----------
+    # Installed BEFORE model build and the "serving" banner: the drain
+    # contract must hold from the first moment a supervisor can observe the
+    # daemon. The old install point sat after a lazy tokenizer probe whose
+    # transformers import left a multi-second window where a SIGTERM racing
+    # the banner still meant die-raw instead of drain.
+    _term_evt = threading.Event()
+    if threading.current_thread() is threading.main_thread():
+        try:
+            signal.signal(signal.SIGTERM, lambda *_: _term_evt.set())
+        except (ValueError, OSError):
+            pass  # embedded interpreter without signal support
     if getattr(args, "data_parallel", 1) > 1:
         # data-parallel daemon: D replica servers over disjoint device
         # groups behind a router (runtime/replicated.py). :placement is a
@@ -610,6 +660,7 @@ def cmd_serve(args) -> int:
             paged_attn=getattr(args, "paged_attn", "auto"),
             prefix_cache=getattr(args, "prefix_cache", "off"),
             host_pool_blocks=getattr(args, "host_pool_blocks", 0),
+            gauge_sweep_every_s=getattr(args, "gauge_sweep_every", 0.0),
             min_replicas=getattr(args, "min_replicas", 1),
         )
         eng = srv.engines[0]
@@ -729,6 +780,7 @@ def cmd_serve(args) -> int:
                 paged_attn=getattr(args, "paged_attn", "auto"),
                 prefix_cache=getattr(args, "prefix_cache", "off"),
                 host_pool_blocks=getattr(args, "host_pool_blocks", 0),
+                gauge_sweep_every_s=getattr(args, "gauge_sweep_every", 0.0),
             )
         # srv.capacity, not args.capacity: after --restore the daemon runs
         # at the SNAPSHOT's serve_kwargs (ADVICE r5 — the banner used to
@@ -741,7 +793,6 @@ def cmd_serve(args) -> int:
         )
     ingress = None
     autoscaler = None
-    _term_evt = threading.Event()
     metrics_srv = _start_metrics(
         getattr(args, "metrics_port", 0),
         # late-bound: ``srv`` is rebound on :placement — the provider always
@@ -749,6 +800,9 @@ def cmd_serve(args) -> int:
         # load too)
         statz_extra={
             "counters": lambda: srv.counters.snapshot(),
+            # step-profiler aggregates (host occupancy, p50 step wall;
+            # per-replica on dp routers)
+            "stepline": lambda: srv.stepline_stats(),
             **(
                 {"replicas": lambda: srv.stats()["replicas"]}
                 if getattr(args, "data_parallel", 1) > 1 else {}
@@ -758,6 +812,15 @@ def cmd_serve(args) -> int:
         # DEGRADED/DRAINING (and on an ingress-level drain) so a load
         # balancer rotates the daemon out
         health=lambda: ingress.health if ingress is not None else srv.health,
+        # /profilez deep capture: None steps = ring view, N = arm + wait.
+        # Late-bound like the rest — :placement rebinds ``srv``.
+        profilez=lambda steps, wait_s: (
+            srv.stepline_capture(steps, wait_s) if steps is not None
+            else {
+                "stepline": srv.stepline_stats(),
+                "steps": srv.stepline_snapshot(64),
+            }
+        ),
     )
     # a tokenizer-less store still serves: the HTTP ingress speaks token
     # ids and stdin prompts get a per-line refusal instead of a dead daemon
@@ -833,12 +896,6 @@ def cmd_serve(args) -> int:
             f"{autoscaler.scale_down_load:g}",
             file=sys.stderr,
         )
-    # -- graceful SIGTERM: DRAINING -> finish in-flight -> exit 0 ----------
-    if threading.current_thread() is threading.main_thread():
-        try:
-            signal.signal(signal.SIGTERM, lambda *_: _term_evt.set())
-        except (ValueError, OSError):
-            pass  # embedded interpreter without signal support
     n_prompt = 0
     for line in _stdin_lines(_term_evt):
         prompt = line.rstrip("\n")
@@ -848,17 +905,21 @@ def cmd_serve(args) -> int:
             if getattr(args, "data_parallel", 1) > 1:
                 srv = _dp_serve_control(srv, prompt)
             else:
-                if ingress is not None:
-                    # freeze dispatch/stepping during the rebuild: the old
-                    # server is drained, re-sharded and closed — a pump
-                    # racing that would submit to (and step) a server
-                    # whose arrays are being swapped under it. Queued HTTP
-                    # requests simply wait out the maintenance window.
+                # freeze dispatch/stepping ONLY for the :placement rebuild:
+                # the old server is drained, re-sharded and closed — a pump
+                # racing that would submit to (and step) a server whose
+                # arrays are being swapped under it. Queued HTTP requests
+                # simply wait out the maintenance window. Read-only controls
+                # must NOT pause: ``:profile N`` waits for the pump to fill
+                # its capture window — pausing it would freeze the very
+                # steps it measures (the bundle came back empty).
+                freeze = ingress is not None and prompt.split()[0] == ":placement"
+                if freeze:
                     ingress.pause()
                 try:
                     srv = _serve_control(eng, srv, prompt, args)
                 finally:
-                    if ingress is not None:
+                    if freeze:
                         if ingress.backend is not srv:
                             # the rebuild produced a new server — point
                             # the front door at the live one
@@ -952,13 +1013,14 @@ def cmd_serve(args) -> int:
     return 0
 
 
-def _start_metrics(port, statz_extra=None, health=None):
+def _start_metrics(port, statz_extra=None, health=None, profilez=None):
     """Start the background ``/metrics`` + ``/statz`` exposition thread when
     a port is requested (0/None = disabled). Returns the MetricsServer or
     None. Bind failures (port taken) are reported and non-fatal — the daemon
     serves without exposition rather than dying. ``health`` (a zero-arg
     callable returning the state name) makes ``/healthz`` answer 503 unless
-    the state is SERVING."""
+    the state is SERVING. ``profilez`` (``fn(steps, wait_s)``) wires the
+    live server's step-profiler capture into ``/profilez``."""
     if not port:
         return None
     from .obs.http import MetricsServer
@@ -967,13 +1029,15 @@ def _start_metrics(port, statz_extra=None, health=None):
         ms = MetricsServer(
             port=port, statz_extra=statz_extra, health_provider=health
         )
+        if profilez is not None:
+            ms.set_profilez_provider(profilez)
         ms.start()
     except OSError as e:
         print(f"metrics endpoint disabled: {e}", file=sys.stderr)
         return None
     print(
         f"metrics: http://127.0.0.1:{ms.port}/metrics (Prometheus), "
-        f"/statz (JSON)",
+        f"/statz (JSON), /profilez (step capture)",
         file=sys.stderr,
     )
     return ms
@@ -1253,6 +1317,39 @@ def cmd_trace_report(args) -> int:
         print(json.dumps(report_json(events, top=args.top), sort_keys=True))
     else:
         print(render_report(events, top=args.top, trace_id=args.trace))
+    return 0
+
+
+def cmd_step_report(args) -> int:
+    """Render step-profiler captures offline: merge ``/profilez`` bundles,
+    ``/debugz`` postmortems and raw ``:profile`` dumps into the per-phase
+    host-time attribution, occupancy timeline and worst device bubbles
+    (see obs/report.py). Runs jax-free — point it at the JSON files
+    wherever they landed."""
+    import glob as _glob
+
+    from .obs.report import (
+        load_steps, render_step_report, step_report_json,
+    )
+
+    paths = []
+    for pat in args.files:
+        hits = sorted(_glob.glob(pat)) if any(
+            c in pat for c in "*?[") else [pat]
+        paths.extend(hits)
+    paths = [p for p in paths if os.path.exists(p)]
+    if not paths:
+        print("no capture files matched", file=sys.stderr)
+        return 2
+    steps = load_steps(paths)
+    if not steps:
+        print("no step records in the input files", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(step_report_json(steps, top=args.top),
+                         sort_keys=True))
+    else:
+        print(render_step_report(steps, top=args.top))
     return 0
 
 
@@ -1548,6 +1645,15 @@ def build_parser() -> argparse.ArgumentParser:
         "adds PATH.ingress for the HTTP root spans",
     )
     s.add_argument(
+        "--gauge-sweep-every", type=float, default=0.0,
+        dest="gauge_sweep_every",
+        help="pace the per-step load-gauge sweep (KV/radix occupancy, "
+        "queue depths) to at most once per SECONDS of wall time, instead "
+        "of every step (default 0.0 = every step, the historical "
+        "behavior). The submit-path sweep is never paced — enqueue-time "
+        "gauges stay fresh",
+    )
+    s.add_argument(
         "--rebalance-every", type=float, default=30.0,
         dest="rebalance_every",
         help="with --autoscale --disagg --profile-json: seconds between "
@@ -1698,6 +1804,28 @@ def build_parser() -> argparse.ArgumentParser:
     )
     tr.set_defaults(fn=cmd_trace_report)
 
+    sr = sub.add_parser(
+        "step-report",
+        help="render step-profiler captures (/profilez bundles, /debugz "
+        "postmortems, :profile dumps): per-phase host-time attribution, "
+        "occupancy timeline, worst device bubbles",
+    )
+    sr.add_argument(
+        "files", nargs="+",
+        help="capture JSON files (globs ok): /profilez?steps=N bundles, "
+        "/debugz bundles (the recent_steps ring tails), or :profile "
+        "output — any mix; records merge sorted by timestamp",
+    )
+    sr.add_argument(
+        "--top", type=int, default=5,
+        help="how many worst device-idle bubbles to list (default 5)",
+    )
+    sr.add_argument(
+        "--json", action="store_true",
+        help="machine-readable report (one JSON object)",
+    )
+    sr.set_defaults(fn=cmd_step_report)
+
     li = sub.add_parser(
         "lint",
         help="shardlint: repo-native static analysis (dispatch/shape-key "
@@ -1748,7 +1876,7 @@ def main(argv=None) -> int:
     # initializes the backend in-process anyway, so the authoritative
     # jax.devices() probe is safe; `worker` must not touch the backend
     # before jax.distributed.initialize, so it falls back to the env var.
-    if args.command in ("trace-report", "lint"):
+    if args.command in ("trace-report", "step-report", "lint"):
         # pure file analysis — no backend, no compile cache, no jax
         # import at all; runs on hosts with no accelerator stack
         return args.fn(args)

@@ -8,15 +8,22 @@ Three modules, all stdlib-only (importable before jax backend init):
 - ``trace``   — request-centric tracing: ``TraceContext`` propagation,
   the rotating JSONL span writer behind the server's ``trace_path=`` knob,
   and the in-memory ``FLIGHT_RECORDER`` span ring;
+- ``stepline`` — the continuous step profiler: one ``StepRecord`` per
+  serve-loop step (disjoint host-phase durations, device-blocked wait,
+  idle-bubble estimate) in a bounded ring, the derived
+  ``server_host_occupancy`` / ``server_device_idle_frac`` gauges, the
+  lock-wait metric sink, and the armable ``/profilez`` deep capture;
 - ``http``    — ``MetricsServer``: a background stdlib-``http.server``
   thread serving ``/metrics`` (Prometheus, with slow-request exemplars),
-  ``/statz`` (JSON), ``/debugz`` (the flight-recorder postmortem bundle)
-  and ``/healthz``, wired into the CLI via ``--metrics-port``;
-- ``report``  — the ``trace-report`` CLI's span-tree reconstruction and
-  per-phase latency attribution over merged per-replica JSONL files.
+  ``/statz`` (JSON), ``/debugz`` (the flight-recorder postmortem bundle),
+  ``/profilez`` (the step profiler's deep-capture window) and
+  ``/healthz``, wired into the CLI via ``--metrics-port``;
+- ``report``  — the ``trace-report`` / ``step-report`` CLIs' span-tree
+  reconstruction and per-phase latency/step attribution over merged
+  per-replica JSONL files and capture bundles.
 
 Metric names and the span schema are documented in README.md
-(§ Observability, § Tracing & postmortems).
+(§ Observability, § Tracing & postmortems, § Step profiling).
 """
 
 from .metrics import (  # noqa: F401
@@ -33,5 +40,11 @@ from .trace import (  # noqa: F401
     TraceContext,
     TraceWriter,
     emit_span,
+)
+from .stepline import (  # noqa: F401
+    PHASES,
+    StepProfiler,
+    StepRecord,
+    debug_snapshot,
 )
 from .http import MetricsServer  # noqa: F401

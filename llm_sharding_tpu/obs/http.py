@@ -12,10 +12,19 @@
   ``Counters.snapshot`` so ``/statz`` carries the exact per-server tally);
 - ``/debugz``  — the flight-recorder postmortem bundle: recent spans from
   the process-wide in-memory ring (``obs.trace.FLIGHT_RECORDER`` — present
-  even when no ``trace_path`` was configured), the metrics snapshot
-  (including slow-request exemplars), every ``/statz`` provider (live
-  counters, per-replica stats with KV/radix occupancy) and the health
-  state, as one JSON object. The first thing to curl after a 504;
+  even when no ``trace_path`` was configured), the step-profiler ring
+  tails of every live server (``obs.stepline.debug_snapshot`` — what the
+  serve loop was DOING per step, not just what spans it emitted), the
+  metrics snapshot (including slow-request exemplars), every ``/statz``
+  provider (live counters, per-replica stats with KV/radix occupancy) and
+  the health state, as one JSON object. The first thing to curl after a
+  504;
+- ``/profilez`` — the step profiler's on-demand window: a bare GET returns
+  ring-tail stats + records; ``?steps=N[&wait_s=S]`` arms an N-step deep
+  capture on the attached provider (the serve CLI wires
+  ``PipelineServer.stepline_capture`` / the dp fan-out) and returns the
+  bundle as JSON — sub-phase timelines, lock-wait deltas, trace_id
+  exemplars;
 - ``/healthz`` — health probe. Without a ``health_provider`` it is a bare
   liveness check (200 ``ok``); with one (the serve CLI attaches the live
   server's health state machine) it returns 200 ``ok`` only while the
@@ -34,10 +43,12 @@ from __future__ import annotations
 import json
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
 from .metrics import REGISTRY, Registry
+from .stepline import debug_snapshot as stepline_debug_snapshot
 from .trace import FLIGHT_RECORDER
 
 
@@ -73,6 +84,9 @@ class MetricsServer:
         self.registry = registry if registry is not None else REGISTRY
         self._extra: Dict[str, Callable[[], object]] = dict(statz_extra or {})
         self._health = health_provider
+        self._profilez: Optional[
+            Callable[[Optional[int], float], dict]
+        ] = None
         self._httpd = ThreadingHTTPServer(
             (host, port), self._handler_class()
         )
@@ -88,6 +102,18 @@ class MetricsServer:
         """Register (or replace) a named JSON provider under ``/statz`` —
         e.g. the live server's counters, per-replica queue depths."""
         self._extra[name] = provider
+
+    def set_profilez_provider(
+        self, provider: Optional[Callable[[Optional[int], float], dict]]
+    ) -> None:
+        """Attach (or detach with ``None``) the ``/profilez`` deep-capture
+        source: ``provider(steps, wait_s)`` with ``steps=None`` for the
+        bare ring-tail view, or an int to arm an N-step capture and block
+        up to ``wait_s`` for it. The serve CLI wires the live server's
+        ``stepline_capture``/``stepline_snapshot`` here; without a
+        provider, ``/profilez`` falls back to the process-wide
+        ``obs.stepline.debug_snapshot`` (read-only, no arming)."""
+        self._profilez = provider
 
     def set_health_provider(
         self, provider: Optional[Callable[[], str]]
@@ -153,15 +179,48 @@ class MetricsServer:
             generated_at=time.time(),
             health=health,
             recent_spans=FLIGHT_RECORDER.snapshot(),
+            recent_steps=stepline_debug_snapshot(),
         )
         return bundle
+
+    def _profilez_payload(self, query: str) -> tuple:
+        """(status_code, payload) for ``/profilez``. ``?steps=N`` arms a
+        deep capture through the attached provider (blocking up to
+        ``wait_s``, default 5 s, capped at 60 — an exposition handler must
+        not park forever); a bare GET is the non-arming ring view."""
+        params = urllib.parse.parse_qs(query)
+        steps: Optional[int] = None
+        if "steps" in params:
+            try:
+                steps = int(params["steps"][-1])
+                if steps < 1:
+                    raise ValueError(steps)
+            except ValueError:
+                return 400, {"error": "steps must be a positive integer"}
+        try:
+            wait_s = min(float(params.get("wait_s", ["5.0"])[-1]), 60.0)
+        except ValueError:
+            return 400, {"error": "wait_s must be a number"}
+        if self._profilez is None:
+            if steps is not None:
+                return 503, {
+                    "error": "no profilez provider attached: deep capture "
+                    "needs a live server (serve --metrics-port wires it)"
+                }
+            return 200, {"profilers": stepline_debug_snapshot()}
+        try:
+            return 200, self._profilez(steps, wait_s)
+        except Exception as e:  # noqa: BLE001 — a dead provider must not
+            # take the endpoint down
+            return 500, {"error": str(e)[:500]}
 
     def _handler_class(self):
         server = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
-                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                path, _, query = self.path.partition("?")
+                path = path.rstrip("/") or "/"
                 code = 200
                 if path == "/metrics":
                     # content negotiation: exemplars are only legal in the
@@ -190,12 +249,18 @@ class MetricsServer:
                         server._debugz_payload(), sort_keys=True
                     ).encode()
                     ctype = "application/json"
+                elif path == "/profilez":
+                    code, payload = server._profilez_payload(query)
+                    body = json.dumps(payload, sort_keys=True).encode()
+                    ctype = "application/json"
                 elif path == "/healthz":
                     code, body = server._health_response()
                     ctype = "text/plain; charset=utf-8"
                 else:
                     self.send_error(
-                        404, "try /metrics, /statz, /debugz or /healthz"
+                        404,
+                        "try /metrics, /statz, /debugz, /profilez or "
+                        "/healthz",
                     )
                     return
                 try:

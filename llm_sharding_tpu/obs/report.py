@@ -1,5 +1,6 @@
-"""Offline trace analysis: merge per-replica JSONL span files, rebuild the
-cross-replica span trees, and attribute latency to phases.
+"""Offline trace + step-profile analysis: merge per-replica JSONL span
+files, rebuild the cross-replica span trees, and attribute latency to
+phases; render step-profiler captures into host/device attribution tables.
 
 The serving stack writes one JSONL trace file per emitter (``<path>`` for a
 single server, ``<path>.r<d>`` per dp replica, ``<path>.router`` for
@@ -14,6 +15,13 @@ crossed.
 per-phase duration percentiles (where does TTFT go — queue, radix miss,
 prefill, hand-off?), the top-N slowest traces with their phase breakdown,
 a per-tenant rollup, and ``--trace ID`` to print one trace's tree.
+
+``python -m llm_sharding_tpu step-report <files...>`` drives the second
+half: it accepts ``/profilez`` capture bundles (single-server or the dp
+``{"r<d>": bundle}`` fan-out), ``/debugz`` bundles (their ``recent_steps``
+ring tails) or raw ``StepRecord`` lists, and renders per-phase host
+attribution, host-occupancy-over-time, and the worst device-idle-bubble
+steps — the offline view of ``obs/stepline``.
 
 Stdlib-only (no numpy/jax): the report runs anywhere the JSONL landed,
 including hosts with no accelerator stack installed.
@@ -361,4 +369,219 @@ def report_json(events, top: int = 5) -> dict:
             for t in slow
         ],
         "tenants": tenant_rollup(traces),
+    }
+
+
+# ---------------------------------------------------------------------------
+# step-report: offline rendering of obs/stepline captures and ring tails
+# ---------------------------------------------------------------------------
+
+
+def _tagged_steps(records, src: str) -> List[dict]:
+    """StepRecord dicts from ``records``, each tagged with its source."""
+    out = []
+    for s in records:
+        if isinstance(s, dict) and "wall_s" in s:
+            s = dict(s)
+            s.setdefault("src", src)
+            out.append(s)
+    return out
+
+
+def extract_steps(data, src: str = "-") -> List[dict]:
+    """Pull StepRecord dicts out of any of the shapes the profiler ships:
+    a raw record list, one ``/profilez`` capture bundle, the dp fan-out
+    (``{"r<d>": bundle}``), a ``/debugz`` bundle (``recent_steps``), or
+    the providerless ``/profilez`` view (``profilers``)."""
+    if isinstance(data, list):
+        return _tagged_steps(data, src)
+    if not isinstance(data, dict):
+        return []
+    if isinstance(data.get("steps"), list):  # one capture bundle
+        return _tagged_steps(data["steps"], str(data.get("profiler", src)))
+    out: List[dict] = []
+    for key in ("recent_steps", "profilers"):
+        if isinstance(data.get(key), list):  # /debugz, bare /profilez
+            for p in data[key]:
+                if isinstance(p, dict):
+                    out += _tagged_steps(
+                        p.get("steps", []), str(p.get("profiler", src))
+                    )
+            return out
+    for k, v in sorted(data.items()):  # dp fan-out {"r0": bundle, ...}
+        if isinstance(v, dict) and isinstance(v.get("steps"), list):
+            out += _tagged_steps(v["steps"], str(v.get("profiler", k)))
+    return out
+
+
+def load_steps(paths) -> List[dict]:
+    """Read step records from JSON files (any supported shape), merged and
+    sorted by timestamp. A file that fails to parse is skipped — the
+    report must run on whatever a postmortem scraped."""
+    steps: List[dict] = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        steps += extract_steps(data, path)
+    steps.sort(key=lambda s: s.get("ts", 0.0))
+    return steps
+
+
+def step_phase_table(steps) -> List[dict]:
+    """Per-phase host attribution over all steps, plus the ``blocked`` and
+    ``unattributed`` pseudo-phases — one row each: count of steps the
+    phase appeared in, p50/p99 per-step duration, total seconds, and the
+    share of total step wall. Sorted by total descending."""
+    wall_total = sum(float(s.get("wall_s", 0.0)) for s in steps) or 1.0
+    buckets: Dict[str, List[float]] = {}
+    for s in steps:
+        for name, dur in (s.get("phases") or {}).items():
+            buckets.setdefault(name, []).append(float(dur))
+        for pseudo in ("blocked", "unattributed"):
+            v = float(s.get(f"{pseudo}_s", 0.0))
+            if v > 0:
+                buckets.setdefault(pseudo, []).append(v)
+    rows = []
+    for name, vals in buckets.items():
+        rows.append({
+            "phase": name,
+            "count": len(vals),
+            "p50_ms": _pctile(vals, 0.50) * 1e3,
+            "p99_ms": _pctile(vals, 0.99) * 1e3,
+            "total_s": sum(vals),
+            "wall_pct": 100.0 * sum(vals) / wall_total,
+        })
+    rows.sort(key=lambda r: -r["total_s"])
+    return rows
+
+
+def step_summary(steps) -> dict:
+    """Aggregate view: step count, total wall, duration-weighted host
+    occupancy / device-idle / blocked / unattributed fractions, tokens
+    applied, and the worst single-step accounting residual (how far
+    ``host + blocked + unattributed`` strays from ``wall`` — 0 by
+    construction unless the input was hand-edited)."""
+    wall = sum(float(s.get("wall_s", 0.0)) for s in steps)
+    host = sum(float(s.get("host_s", 0.0)) for s in steps)
+    blocked = sum(float(s.get("blocked_s", 0.0)) for s in steps)
+    idle = sum(float(s.get("idle_s", 0.0)) for s in steps)
+    unatt = sum(float(s.get("unattributed_s", 0.0)) for s in steps)
+    walls = [float(s.get("wall_s", 0.0)) for s in steps]
+    resid = max(
+        (
+            abs(
+                float(s.get("wall_s", 0.0))
+                - float(s.get("host_s", 0.0))
+                - float(s.get("blocked_s", 0.0))
+                - float(s.get("unattributed_s", 0.0))
+            )
+            for s in steps
+        ),
+        default=0.0,
+    )
+    return {
+        "steps": len(steps),
+        "wall_s": wall,
+        "step_wall_p50_ms": _pctile(walls, 0.50) * 1e3,
+        "step_wall_p99_ms": _pctile(walls, 0.99) * 1e3,
+        "host_occupancy": host / wall if wall > 0 else 0.0,
+        "blocked_frac": blocked / wall if wall > 0 else 0.0,
+        "device_idle_frac": idle / wall if wall > 0 else 0.0,
+        "unattributed_frac": unatt / wall if wall > 0 else 0.0,
+        "tokens": sum(int(s.get("tokens", 0)) for s in steps),
+        "max_accounting_residual_s": resid,
+    }
+
+
+def occupancy_timeline(steps, bins: int = 20) -> List[dict]:
+    """Host occupancy over time: the (timestamp-sorted) steps split into up
+    to ``bins`` contiguous groups, each reduced to its duration-weighted
+    occupancy — the serial-loop scaling curve at a glance."""
+    n = len(steps)
+    if n == 0:
+        return []
+    bins = max(1, min(bins, n))
+    out = []
+    for b in range(bins):
+        lo, hi = (n * b) // bins, (n * (b + 1)) // bins
+        group = steps[lo:hi]
+        if not group:
+            continue
+        wall = sum(float(s.get("wall_s", 0.0)) for s in group)
+        host = sum(float(s.get("host_s", 0.0)) for s in group)
+        out.append({
+            "steps": len(group),
+            "rows_max": max(int(s.get("rows", 0)) for s in group),
+            "occupancy": host / wall if wall > 0 else 0.0,
+        })
+    return out
+
+
+def worst_bubbles(steps, top: int = 5) -> List[dict]:
+    """The steps with the largest device-idle bubbles, worst first."""
+    ranked = sorted(
+        (s for s in steps if float(s.get("idle_s", 0.0)) > 0),
+        key=lambda s: -float(s["idle_s"]),
+    )
+    return ranked[:top]
+
+
+def render_step_report(steps, top: int = 5) -> str:
+    """The step-report text: summary, per-phase attribution, occupancy
+    over time, worst bubbles."""
+    if not steps:
+        return "no step records in the input"
+    s = step_summary(steps)
+    lines = [
+        f"{s['steps']} step(s), {s['wall_s']:.3f}s wall, "
+        f"{s['tokens']} token(s)",
+        f"  host_occupancy={s['host_occupancy']:.3f}  "
+        f"blocked={s['blocked_frac']:.3f}  "
+        f"device_idle={s['device_idle_frac']:.3f}  "
+        f"unattributed={s['unattributed_frac']:.3f}",
+        f"  step_wall p50={s['step_wall_p50_ms']:.2f}ms "
+        f"p99={s['step_wall_p99_ms']:.2f}ms",
+        "",
+        "per-phase host attribution:",
+        f"  {'phase':<14} {'count':>7} {'p50_ms':>9} {'p99_ms':>9} "
+        f"{'total_s':>9} {'wall%':>7}",
+    ]
+    for r in step_phase_table(steps):
+        lines.append(
+            f"  {r['phase']:<14} {r['count']:>7} {r['p50_ms']:>9.2f} "
+            f"{r['p99_ms']:>9.2f} {r['total_s']:>9.3f} "
+            f"{r['wall_pct']:>6.1f}%"
+        )
+    timeline = occupancy_timeline(steps)
+    if len(timeline) > 1:
+        lines += ["", "host occupancy over time (oldest first):"]
+        for i, b in enumerate(timeline):
+            bar = "#" * int(round(b["occupancy"] * 40))
+            lines.append(
+                f"  [{i:>3}] occ={b['occupancy']:.3f} "
+                f"rows<={b['rows_max']:<4} |{bar:<40}|"
+            )
+    bubbles = worst_bubbles(steps, top)
+    if bubbles:
+        lines += ["", f"top {len(bubbles)} device-idle bubble step(s):"]
+        for b in bubbles:
+            lines.append(
+                f"  src={b.get('src', '-')} idle={b['idle_s'] * 1e3:.2f}ms "
+                f"wall={float(b.get('wall_s', 0.0)) * 1e3:.2f}ms "
+                f"rows={b.get('rows', 0)} tokens={b.get('tokens', 0)}"
+            )
+    return "\n".join(lines)
+
+
+def step_report_json(steps, top: int = 5) -> dict:
+    """The same step report as machine-readable JSON
+    (``step-report --json``)."""
+    return {
+        "summary": step_summary(steps),
+        "phases": step_phase_table(steps),
+        "timeline": occupancy_timeline(steps),
+        "worst_bubbles": worst_bubbles(steps, top),
     }

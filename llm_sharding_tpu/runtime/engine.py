@@ -513,6 +513,7 @@ class PipelineEngine:
         paged_attn: str = "auto",
         prefix_cache: str = "off",
         host_pool_blocks: int = 0,
+        gauge_sweep_every_s: float = 0.0,
     ):
         """Build a continuous-batching server over this engine's sharded
         arrays (≙ the reference's persistent ``run_worker_loop`` daemon,
@@ -554,7 +555,12 @@ class PipelineEngine:
         ``fault_plan=``/``fault_retries=``/``fault_backoff_s=``/
         ``retryable_exceptions=`` configure fault injection and the
         transient-retry policy, and ``snapshot_every_s=``+``snapshot_path=``
-        arm periodic atomic crash-recovery checkpoints."""
+        arm periodic atomic crash-recovery checkpoints.
+
+        ``gauge_sweep_every_s=`` paces the per-step load/KV/attn gauge
+        sweep (0, the default, sweeps every step — the historical
+        behavior); the step profiler (``server.stepline``) makes the
+        sweep's per-step cost visible as its ``gauge_sweep`` phase."""
         self._validate_serve()
         from .server import PipelineServer
 
@@ -584,6 +590,7 @@ class PipelineEngine:
             paged_attn=paged_attn,
             prefix_cache=prefix_cache,
             host_pool_blocks=host_pool_blocks,
+            gauge_sweep_every_s=gauge_sweep_every_s,
         )
 
     def _shared_server(self, prompt_len: int, max_new: int):

@@ -237,6 +237,7 @@ class ReplicatedServer:
             **self._serve_kwargs,
         )
         srv._span_src = f"r{d}"  # flight-recorder spans name their replica
+        srv.stepline.name = f"r{d}"  # /debugz step rings likewise
         self.engines.append(eng)
         self.servers.append(srv)
         self._by_group[d] = srv
@@ -845,6 +846,7 @@ class ReplicatedServer:
             replicas = []
             for d in sorted(self._by_group):
                 s = self._by_group[d]
+                sl = s.stepline_stats()
                 entry = {
                     "replica": d,
                     "health": s.health,
@@ -853,6 +855,10 @@ class ReplicatedServer:
                     "in_flight": sum(
                         r is not None and not r.done for r in s._rows
                     ),
+                    # step-profiler view: which replica's pump is
+                    # host-bound, and how long its steps are
+                    "host_occupancy": sl["host_occupancy"],
+                    "step_wall_p50_ms": sl["step_wall_p50_ms"],
                 }
                 if s.paged:
                     entry["kv_blocks_in_use"] = s._alloc.in_use
@@ -874,3 +880,59 @@ class ReplicatedServer:
                     if d not in self._by_group
                 ),
             }
+
+    # ------------------------------------------------ step profiler fan-out
+
+    def stepline_stats(self, last_n: int = 64) -> dict:
+        """Per-replica step-profiler aggregates, keyed ``r<d>``."""
+        with self._lock:
+            return {
+                f"r{d}": self._by_group[d].stepline_stats(last_n)
+                for d in sorted(self._by_group)
+            }
+
+    def stepline_snapshot(self, last_n: Optional[int] = None) -> dict:
+        """Per-replica step-ring tails, keyed ``r<d>``."""
+        with self._lock:
+            return {
+                f"r{d}": self._by_group[d].stepline_snapshot(last_n)
+                for d in sorted(self._by_group)
+            }
+
+    def stepline_capture(self, steps: int, wait_s: float = 5.0,
+                         trace_dir: Optional[str] = None) -> dict:
+        """Deep-capture fan-out: arm EVERY replica first (so the windows
+        overlap in wall time), then wait out one shared deadline and
+        return ``{"r<d>": bundle}``. ``trace_dir`` brackets the whole
+        window with one process-wide ``jax.profiler`` trace (devices are
+        per-replica but the profiler is per-process)."""
+        with self._lock:
+            servers = [
+                (d, self._by_group[d]) for d in sorted(self._by_group)
+            ]
+        trace_on = False
+        if trace_dir:
+            try:
+                jax.profiler.start_trace(trace_dir)
+                trace_on = True
+            except Exception as e:  # noqa: BLE001 — capture works without
+                logger.warning("device trace unavailable: %r", e)
+        try:
+            for _, s in servers:
+                s.stepline.arm(steps)
+            deadline = time.perf_counter() + wait_s
+            out = {}
+            for d, s in servers:
+                s.stepline.wait_capture(
+                    max(0.0, deadline - time.perf_counter())
+                )
+                out[f"r{d}"] = s.stepline.capture_bundle()
+        finally:
+            if trace_on:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception as e:  # noqa: BLE001
+                    logger.warning("device trace stop failed: %r", e)
+        if trace_on:
+            out["device_trace_dir"] = trace_dir
+        return out

@@ -81,6 +81,7 @@ from ..obs.metrics import (
     record_shape_key, set_prefill_path,
 )
 from ..obs.trace import TraceContext, TraceWriter, emit_span
+from ..obs.stepline import StepProfiler
 from ..analysis.lockorder import named_lock
 from ..parallel import serve as serve_ops
 from ..parallel.mesh import PIPE_AXIS
@@ -145,13 +146,6 @@ _M_TOK_S = REGISTRY.histogram(
     "server_request_tok_s",
     "Per-request decode rate over its admission-to-finish window",
     buckets=DEFAULT_RATE_BUCKETS,
-)
-_M_STEP_PHASE = REGISTRY.histogram(
-    "server_step_phase_seconds",
-    "Serving-loop phase durations: admit (prefill dispatch incl. the "
-    "pre-admission log flush), dispatch (host-side chunk dispatch; the "
-    "device executes async), apply (log drain incl. any blocking fetch)",
-    labels=("phase",),
 )
 _M_QUEUE_DEPTH = REGISTRY.gauge(
     "server_queue_depth",
@@ -361,7 +355,7 @@ class _Prefetched:
     queue stays full (measured: the synchronous fetch cost ~36 ms of the
     ~240 ms serve iteration on the tunneled chip)."""
 
-    __slots__ = ("handle", "value", "error", "event", "tag")
+    __slots__ = ("handle", "value", "error", "event", "tag", "done_at")
 
     def __init__(self, handle, tag: str = "?"):
         self.handle = handle
@@ -369,6 +363,9 @@ class _Prefetched:
         self.value = None
         self.error: Optional[BaseException] = None
         self.event = threading.Event()
+        # perf_counter stamp of when the value landed on host — the step
+        # profiler's device-idle estimate (log ready vs next dispatch)
+        self.done_at: Optional[float] = None
 
     def get(self) -> np.ndarray:
         self.event.wait()
@@ -407,6 +404,7 @@ class _Prefetched:
             ) from e
         self.error = None
         self.handle = None
+        self.done_at = time.perf_counter()
         return self.value
 
 
@@ -451,6 +449,7 @@ class _Prefetcher:
                 p.event.set()
                 continue  # KEEP the handle: get_retryable re-issues the read
             p.handle = None  # drop the device reference promptly
+            p.done_at = time.perf_counter()
             p.event.set()
 
 
@@ -900,6 +899,7 @@ class PipelineServer:
         paged_attn: str = "auto",
         prefix_cache: str = "off",
         host_pool_blocks: int = 0,
+        gauge_sweep_every_s: float = 0.0,
     ):
         self.engine = engine
         self.cfg = engine.cfg
@@ -1251,6 +1251,21 @@ class PipelineServer:
         # can never interleave with a mid-chunked admission (ADVICE r3 #4).
         # Re-entrant because stream() → step() runs under the same lock.
         self._mutex = named_lock("server.mutex", "rlock")
+        # continuous step profiler (obs/stepline): one StepRecord per step()
+        # into a bounded ring, host-occupancy/device-idle gauges, and the
+        # /profilez deep-capture window. Public: benches toggle it, the CLI
+        # and HTTP exposition read it.
+        self.stepline = StepProfiler(name="server")
+        # pace the per-step load/KV/attn gauge sweep: 0.0 (default) keeps
+        # the historical sweep-every-step behavior; at 64+ rows the sweep's
+        # row scan is real per-step host work (visible as the profiler's
+        # gauge_sweep phase), so ops can stretch it to e.g. 0.5 s.
+        if gauge_sweep_every_s < 0:
+            raise ValueError(
+                f"gauge_sweep_every_s must be >= 0, got {gauge_sweep_every_s}"
+            )
+        self.gauge_sweep_every_s = float(gauge_sweep_every_s)
+        self._last_gauge_sweep = 0.0  # perf_counter of the last in-step sweep
         # register LAST: a concurrent gauge sweep from another serving
         # thread must never see a half-constructed server (_alloc,
         # _mirror_len, _queue, _rows are all read by _update_load_gauges)
@@ -1925,10 +1940,14 @@ class PipelineServer:
         round-trip disappears behind compute. Tokens therefore surface one
         chunk late; ``run_until_idle`` drains the tail.
 
-        Each phase records its duration under
-        ``server_step_phase_seconds{phase=admit|dispatch|apply}`` — note the
-        dispatch figure is HOST dispatch time (the chunk executes async on
-        device); with ``trace_path=`` the phases also land as JSONL spans.
+        Every step records one ``StepRecord`` into ``self.stepline`` (the
+        ``obs/stepline`` continuous profiler): disjoint host-phase durations
+        under ``server_step_phase_seconds{phase=admit|radix_plan|table_push|
+        dispatch|fetch|apply|gauge_sweep}``, device-blocked wait, and the
+        derived ``server_host_occupancy`` / ``server_device_idle_frac``
+        gauges — note the dispatch figure is HOST dispatch time (the chunk
+        executes async on device); with ``trace_path=`` the coarse phases
+        also land as JSONL spans.
 
         With ``speculate=K`` the decode chunk is replaced by per-slot
         ``serve_verify`` traversals (``_spec_step``): each commits a
@@ -1945,7 +1964,11 @@ class PipelineServer:
         with self._mutex:
             if self._closed:
                 return False
+            sl = self.stepline
+            sl.begin_step()
+            tok0 = self.counters.tokens_generated
             self._step_contained = False
+            sl.push("admit")
             progressed = self._shed_expired()
             if self._queue and self._free_slots():
                 # admission needs accurate mirrors → flush outstanding logs
@@ -1953,22 +1976,17 @@ class PipelineServer:
                 # free slot: under full-slot backlog the flush would block on
                 # the in-flight chunk every step and defeat the pipelining; a
                 # slot freed inside an un-applied log is seen one step later.
-                t0 = time.perf_counter()
                 self._drain(0)
                 progressed |= self._admit_pending()
-                _M_STEP_PHASE.labels(phase="admit").observe(
-                    time.perf_counter() - t0
-                )
+            sl.pop()
             if self.speculate and self._any_active():
                 # speculative decode replaces the interleaved chunk: per
                 # active slot, draft on host, verify K+1 positions in one
                 # forward, commit a variable number of tokens per row
-                t0 = time.perf_counter()
+                sl.push("dispatch")
                 self._spec_step()
+                sl.pop()
                 progressed = True
-                _M_STEP_PHASE.labels(phase="dispatch").observe(
-                    time.perf_counter() - t0
-                )
                 t0 = time.perf_counter()
                 applied = self._drain(0)  # next drafts need these commits
             elif self._any_active():
@@ -1981,16 +1999,26 @@ class PipelineServer:
                 applied = self._drain(0)
             dt_apply = time.perf_counter() - t0
             if progressed or applied:
-                _M_STEP_PHASE.labels(phase="apply").observe(dt_apply)
                 self._span("apply", dur_s=dt_apply, applied=applied)
-                _update_load_gauges()
+                now = time.perf_counter()
+                if (
+                    self.gauge_sweep_every_s <= 0.0
+                    or now - self._last_gauge_sweep
+                    >= self.gauge_sweep_every_s
+                ):
+                    sl.push("gauge_sweep")
+                    _update_load_gauges()
+                    sl.pop()
+                    self._last_gauge_sweep = now
             if self._radix is not None and self._queue:
                 # stage the NEXT admission's radix plan now, AFTER this
                 # step's decode dispatch: a host-tier restore it triggers
                 # rides the device queue behind the in-flight chunk and
                 # overlaps its compute, instead of serializing restore →
                 # admit inside the next step's admission phase
+                sl.push("radix_plan")
                 self._stage_radix_plan()
+                sl.pop()
             snap_due = self._capture_autosnapshot()
             if (
                 self._health == DEGRADED
@@ -2008,6 +2036,14 @@ class PipelineServer:
             ):
                 # a clean step after containment: recovered
                 self._set_health(SERVING)
+            sl.end_step(
+                rows=sum(
+                    1 for r in self._rows if r is not None and not r.done
+                ),
+                tokens=self.counters.tokens_generated - tok0,
+                queued=len(self._queue),
+                pending=len(self._pending),
+            )
         # the npz serialization + atomic rename of a potentially multi-GB
         # state runs OUTSIDE the mutex: only this pump thread pays the
         # write; stream()/submit() consumers on other threads stay live
@@ -2020,6 +2056,15 @@ class PipelineServer:
         dispatch failures; a persistent failure is contained (the rows this
         chunk was driving fail, the daemon survives)."""
         t0 = time.perf_counter()
+        if self._pending:
+            # device-idle estimate: the newest in-flight chunk is the last
+            # work the device was given — if its log has already landed on
+            # host (done_at stamped), the device has been draining/idle
+            # since then, and this dispatch ends the bubble
+            newest = self._pending[-1][1]
+            if newest.done_at is not None and newest.event.is_set():
+                self.stepline.idle(t0 - newest.done_at)
+        self.stepline.push("dispatch")
         cycles = self.num_stages * self.chunk_cycles
         # the dispatched static, not attn_impl: dense servers compile the
         # programs with attn="xla" (the arg is inert at block_size=0), and
@@ -2056,6 +2101,7 @@ class PipelineServer:
                 "chunk_dispatch", do_chunk, real_ok=False
             )
         except Exception as e:  # noqa: BLE001 — persistent: contain it
+            self.stepline.pop()
             self._contain_dispatch_failure("chunk_dispatch", e)
             return
         self._pending.append(
@@ -2068,8 +2114,8 @@ class PipelineServer:
              if r is not None and not r.done],
             steps=self.chunk_cycles,
         )
+        self.stepline.pop()
         dt_dispatch = time.perf_counter() - t0
-        _M_STEP_PHASE.labels(phase="dispatch").observe(dt_dispatch)
         self._span("chunk", dur_s=dt_dispatch, m0=self._m, cycles=cycles)
         self._m += cycles
         self.counters.inc("chunks")
@@ -2081,6 +2127,47 @@ class PipelineServer:
             self._queue or self._any_active() or self._pending
         ):
             self.step()
+
+    def stepline_stats(self, last_n: int = 64) -> dict:
+        """Step-profiler aggregates over the ring tail (host occupancy,
+        device-idle fraction, p50 step wall) — rides ``:stats`` and the
+        per-replica entries of ``ReplicatedServer.stats()``."""
+        return self.stepline.stats(last_n)
+
+    def stepline_snapshot(self, last_n: Optional[int] = None) -> list:
+        """The step ring's records oldest-first (JSON-ready dicts)."""
+        return self.stepline.snapshot(last_n)
+
+    def stepline_capture(self, steps: int, wait_s: float = 5.0,
+                         trace_dir: Optional[str] = None) -> dict:
+        """Arm an N-step deep capture (full sub-phase timeline, lock-wait
+        deltas, applied-row trace_id exemplars) and wait up to ``wait_s``
+        for the step pump to fill it; the bundle reports ``complete: false``
+        if the loop idled first. With ``trace_dir`` a ``jax.profiler``
+        device trace brackets the window (TPU: the dump dir holds the
+        xplane protos; unavailable backends degrade to host-only capture).
+
+        The wait happens OUTSIDE the serving mutex — call from any thread
+        while the pump steps; or arm via ``self.stepline.arm`` and drive
+        ``step()`` yourself (the single-threaded test shape)."""
+        trace_on = False
+        if trace_dir:
+            try:
+                jax.profiler.start_trace(trace_dir)
+                trace_on = True
+            except Exception as e:  # noqa: BLE001 — capture works without
+                logger.warning("device trace unavailable: %r", e)
+        try:
+            bundle = self.stepline.capture(steps, wait_s)
+        finally:
+            if trace_on:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception as e:  # noqa: BLE001
+                    logger.warning("device trace stop failed: %r", e)
+        if trace_on:
+            bundle["device_trace_dir"] = trace_dir
+        return bundle
 
     @property
     def health(self) -> str:
@@ -2518,12 +2605,14 @@ class PipelineServer:
         """Ship the host block-table mirror to the device state (replicated
         leaf — no program dispatch, just a small transfer; the next
         dispatched program closes over the new tables)."""
+        self.stepline.push("table_push")
         self._tables_dirty = False
         self.state = self.state._replace(
             block_tables=jax.device_put(
                 self._tables, self.state.block_tables.sharding
             )
         )
+        self.stepline.pop()
 
     def _flush_tables(self) -> None:
         """Push deferred release remaps before a program dispatch."""
@@ -3296,7 +3385,9 @@ class PipelineServer:
             rplan = head.staged_radix
             head.staged_radix = None
             if rplan is None:
+                self.stepline.push("radix_plan")
                 rplan = self._radix_plan(head)
+                self.stepline.pop()
             spx_n = 0 if rplan is None else rplan.n
             # Co-admit only same-bucket requests: submit() validated each
             # request's capacity needs against ITS OWN bucket, and admission
@@ -3885,9 +3976,19 @@ class PipelineServer:
         and draining continues with the next entry — one poisoned read
         never wedges the apply path."""
         applied = 0
+        sl = self.stepline
+        sl.push("fetch")
         while len(self._pending) > max_pending:
             entry = self._pending.popleft()
             applied += 1
+            if not entry[1].event.is_set():
+                # blocked on device: the log hasn't materialized on host
+                # yet. The wait is measured SEPARATELY from host compute
+                # (the profiler's blocked_s — excluded from the fetch
+                # phase); the retryable get below then returns instantly.
+                tb = time.perf_counter()
+                entry[1].event.wait()
+                sl.blocked(time.perf_counter() - tb)
             try:
                 value = self._retry(
                     "log_fetch",
@@ -3898,6 +3999,7 @@ class PipelineServer:
             except Exception as err:  # noqa: BLE001 — the log is lost
                 self._contain_lost_log(entry, err)
                 continue
+            sl.push("apply")
             if entry[0] == "chunk":
                 self._apply_log(value, entry[2])
             elif entry[0] == "spec":
@@ -3907,6 +4009,8 @@ class PipelineServer:
                     if req.done or self._rows[row] is not req:
                         continue  # cancelled between dispatch and drain
                     self._apply_token(row, req, int(value[i]))
+            sl.pop()
+        sl.pop()
         return applied
 
     def _apply_log(self, log: np.ndarray, m0: int) -> None:
@@ -3948,6 +4052,8 @@ class PipelineServer:
                 self._contain_rows("request_apply", [(row, req)], e)
                 return
         req.tokens.append(t)
+        # deep-capture exemplar: no-op unless a /profilez window is armed
+        self.stepline.note_exemplar(req.trace.trace_id)
         now = time.perf_counter()
         if req.first_token_at is None:
             req.first_token_at = now
