@@ -68,6 +68,8 @@ ORDER: Tuple[str, ...] = (
     "replica.router",         # ReplicatedServer._lock (RLock)
     "server.prefetcher",      # _Prefetcher singleton construction
     "server.mutex",           # PipelineServer._mutex (RLock): step state
+    "server.scheduler",       # async-exec scheduler kick/delta condition
+    "server.exec_sidecar",    # async-exec completion-sidecar wake condition
     "disagg.handoff",         # sidecar rendezvous condition (counters only)
     "engine.reconfig",        # PipelineEngine._lock: placement swap vs use
     "faults.plan",            # FaultPlan arming/matching

@@ -281,6 +281,7 @@ def _serve_control(eng, srv, line: str, args):
                 chunk_cycles=srv.chunk_cycles,
                 prefill_chunk=srv.prefill_chunk,
                 pipeline_depth=srv.pipeline_depth,
+                inflight_steps=srv.inflight_steps,
                 top_k=srv.top_k,
                 top_p=srv.top_p,
                 trace_path=getattr(args, "trace_path", None),
@@ -445,6 +446,15 @@ def cmd_serve(args) -> int:
             "error: --kv-block-size and --kv-blocks go together "
             f"(got --kv-block-size {args.kv_block_size or 0}, "
             f"--kv-blocks {args.kv_blocks or 0})",
+            file=sys.stderr,
+        )
+        return 2
+    if getattr(args, "inflight_steps", 1) < 1:
+        # same fast-fail-before-model-load pattern: PipelineServer validates
+        # this too, but only after minutes of checkpoint loading
+        print(
+            f"error: --inflight-steps must be >= 1, got "
+            f"{args.inflight_steps}",
             file=sys.stderr,
         )
         return 2
@@ -650,6 +660,7 @@ def cmd_serve(args) -> int:
             trace_path=args.trace_path,
             speculate=args.speculate,
             spec_ngram=args.spec_ngram,
+            inflight_steps=getattr(args, "inflight_steps", 1),
             max_queue=args.max_queue or None,
             default_deadline_s=args.default_deadline or None,
             snapshot_every_s=args.snapshot_every or None,
@@ -727,6 +738,8 @@ def cmd_serve(args) -> int:
                      srv.speculate),
                     ("spec_ngram", getattr(args, "spec_ngram", 3),
                      srv.spec_ngram),
+                    ("inflight_steps", getattr(args, "inflight_steps", 1),
+                     srv.inflight_steps),
                     ("max_queue", args.max_queue or None, srv.max_queue),
                     ("default_deadline", args.default_deadline or None,
                      srv.default_deadline_s),
@@ -770,6 +783,7 @@ def cmd_serve(args) -> int:
                 trace_path=args.trace_path,
                 speculate=args.speculate,
                 spec_ngram=args.spec_ngram,
+                inflight_steps=getattr(args, "inflight_steps", 1),
                 max_queue=args.max_queue or None,
                 default_deadline_s=args.default_deadline or None,
                 snapshot_every_s=args.snapshot_every or None,
@@ -1456,6 +1470,16 @@ def build_parser() -> argparse.ArgumentParser:
         "--spec-ngram", type=int, default=3, dest="spec_ngram",
         help="longest n-gram the drafter matches against the request's "
         "prompt+generation suffix (with --speculate)",
+    )
+    s.add_argument(
+        "--inflight-steps", type=int, default=1, dest="inflight_steps",
+        help="async executor depth (runtime/async_exec.py): keep up to N "
+        "decode dispatches enqueued on device while an off-thread "
+        "scheduler plans admissions/evictions and a completion sidecar "
+        "applies landed tokens — the host-side step bubble overlaps "
+        "device compute. Greedy output stays token-identical at any "
+        "depth; tokens surface up to N chunks late. 1 (default) is the "
+        "historical serial step loop and the rollback",
     )
     s.add_argument("--dtype", default="bf16")
     s.add_argument("--temperature", type=float, default=0.0)

@@ -1166,7 +1166,20 @@ def serve_chunk(
     measured ~20% serve throughput on v5e at 3B); True compiles the per-row
     seeded sampler. The host flips it the first time a temperature>0 request
     is admitted (one extra compile, then cached). ``filtering`` likewise
-    compiles the top-k/top-p machinery in only when some request uses it."""
+    compiles the top-k/top-p machinery in only when some request uses it.
+
+    MULTI-DISPATCH CONTRACT (the async executor's load-bearing property,
+    runtime/async_exec.py): ``state`` is donated and the chunk is fully
+    self-contained — everything the next chunk needs is in the returned
+    ``ServeState`` handle, nothing depends on the host having read ``log``.
+    Chunk k+1 may therefore be dispatched off chunk k's returned handle
+    BEFORE k's log is fetched, to any depth: the dispatches serialize on
+    the device as one deterministic state chain, so the committed tokens
+    are identical whether the host fetches each log immediately (serial
+    step loop) or ``inflight_steps`` chunks later (async executor). The
+    host block-table push (``_flush_tables``) needs only the PLANNED
+    mirror deltas, never fetched tokens, so it keeps its place before
+    each dispatch."""
     fns = model_fns(cfg, tp_axis=TENSOR_AXIS if tp > 1 else None)
     ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
     last = num_stages - 1

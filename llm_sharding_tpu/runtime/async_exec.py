@@ -1,0 +1,257 @@
+"""Async executor: the scheduler/executor split behind ``inflight_steps``.
+
+With ``inflight_steps=1`` (the default) ``PipelineServer.step()`` is the
+historical serial loop: one thread does everything — deadline sweep, radix
+staging, admission, dispatch, the BLOCKING log fetch, per-row apply, gauge
+sweep — under the server mutex, so at 64+ rows and sub-ms decode kernels
+the host is the bottleneck and the device drains between steps (the PR-16
+stepline's ``server_device_idle_frac`` measures exactly this bubble).
+
+``inflight_steps=N>1`` splits the loop three ways (vLLM multi-step /
+Sarathi-Serve stall-free scheduling, applied to the pipeline-ring server):
+
+- the **executor** is ``step()`` itself, reduced to the hot path: consume
+  the scheduler's published delta, admit if a slot is free, dispatch the
+  next chunk, and only apply logs inline when the in-flight window is full
+  (backpressure) — it keeps up to N decode dispatches enqueued, legal
+  because ``serve_chunk`` is state-donating and self-contained, so chunk
+  k+1 chains off chunk k's returned state handle without waiting for k's
+  log to reach the host;
+- the :class:`_StepScheduler` thread plans the NEXT boundary's work off
+  the critical path: deadline-sweep candidates (published as an immutable
+  :class:`SchedulerDelta` the executor re-validates before acting on —
+  plan-time state may be stale by apply time), the queue head's staged
+  radix plan, and the paced load-gauge sweep;
+- the :class:`_CompletionSidecar` thread applies landed token logs and
+  thereby feeds ``stream()``/``result()`` consumers between executor
+  steps — token apply + SSE fan-out leave the step critical path (the
+  same pattern as the PR-12 disagg hand-off sidecar).
+
+Correctness invariants (tests/test_async_exec.py):
+
+- **Token identity**: the device-side computation is one deterministic
+  state chain regardless of host threading — greedy output is
+  token-identical to the serial loop at every depth. Applies stay ordered
+  (the sidecar and every inline drain pop ``_pending`` oldest-first under
+  the server mutex) and late tokens for finished rows are skipped by the
+  same ``req.done`` guards the serial ``pipeline_depth>1`` mode relies on.
+- **Settled boundaries**: the sidecar never holds an entry outside the
+  mutex — it pops and applies in one critical section — so any
+  ``_drain(0)`` under the mutex (snapshot, admission flush, elective
+  drain, ``extract``'s settle) leaves no un-applied log anywhere.
+- **Lock order**: both helper threads acquire their own condition
+  (``server.scheduler`` / ``server.exec_sidecar``, ranked directly after
+  ``server.mutex``) and the server mutex strictly sequentially, never
+  nested; the executor, holding the mutex, may kick either condition
+  (later rank). Chaos suites run under ``SHARDLINT_LOCK_ORDER=1``.
+- **Liveness without the threads**: the executor falls back to the inline
+  deadline sweep when no delta is published and applies logs itself at
+  the in-flight cap — a starved scheduler or sidecar degrades throughput,
+  never correctness.
+
+Both threads hold only a weakref to the server: an unclosed depth>N
+server (tests create thousands) parks its threads until collection
+instead of pinning the server alive; ``close()`` stops and joins them.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import weakref
+from typing import Optional, Tuple
+
+from ..analysis.lockorder import named_lock
+from ..obs.metrics import REGISTRY
+
+logger = logging.getLogger(__name__)
+
+INFLIGHT_STEPS = REGISTRY.gauge(
+    "server_inflight_steps",
+    "Configured async-executor depth: how many decode dispatches may stay "
+    "enqueued on device before the executor applies logs inline (1 = the "
+    "serial step loop; last-constructed server wins across dp replicas)",
+)
+SCHEDULER_LAG = REGISTRY.histogram(
+    "server_scheduler_lag_seconds",
+    "Async executor: age of the scheduler's published delta when the "
+    "executor applies it at a step boundary (planned -> applied) — the "
+    "staleness bound on off-thread deadline/eviction planning",
+)
+
+
+class SchedulerDelta:
+    """One immutable planning result, published scheduler → executor.
+
+    The executor RE-VALIDATES every candidate against live state before
+    acting (the request may have finished, admitted, or been cancelled
+    since plan time); ``planned_at`` feeds ``server_scheduler_lag_seconds``
+    at apply time. Radix staging and gauge sweeps mutate in place under
+    the mutex on the scheduler thread (both are one-step-ahead caches by
+    design) and therefore don't ride the delta."""
+
+    __slots__ = ("planned_at", "plan_s", "expire_queued", "expire_rows")
+
+    def __init__(self, planned_at: float, plan_s: float,
+                 expire_queued: Tuple, expire_rows: Tuple):
+        self.planned_at = planned_at
+        self.plan_s = plan_s
+        self.expire_queued = expire_queued  # Request, still queued at plan
+        self.expire_rows = expire_rows      # (row, Request), in flight
+
+
+class _StepScheduler(threading.Thread):
+    """Plans step k+2 while step k+1 executes: deadline-sweep candidates
+    (→ :class:`SchedulerDelta`), the queue head's staged radix plan, and
+    the paced gauge sweep. Kicked once per executor step; parks on its
+    condition otherwise. Plan time lands in the ``plan`` phase histogram
+    via ``observe_offthread`` — it OVERLAPS executor wall, so it must not
+    enter any StepRecord."""
+
+    def __init__(self, srv):
+        super().__init__(daemon=True, name="serve-scheduler")
+        self._ref = weakref.ref(srv)
+        self._cv = named_lock("server.scheduler", "condition")
+        self._kicked = False
+        self._stopped = False
+        self._delta: Optional[SchedulerDelta] = None
+
+    def kick(self) -> None:
+        """Request one planning pass (executor, end of step, under the
+        server mutex — the condition ranks after it)."""
+        with self._cv:
+            self._kicked = True
+            self._cv.notify()
+
+    def take(self) -> Optional[SchedulerDelta]:
+        """Consume the latest published delta (executor, start of step)."""
+        with self._cv:
+            d, self._delta = self._delta, None
+            return d
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify()
+
+    def run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._kicked and not self._stopped:
+                    if not self._cv.wait(0.5) and self._ref() is None:
+                        return  # server collected without close()
+                if self._stopped:
+                    return
+                self._kicked = False
+            srv = self._ref()
+            if srv is None:
+                return
+            try:
+                delta = self._plan(srv)
+            except Exception:  # noqa: BLE001 — a planning failure must
+                # never kill the thread: the executor's inline fallback
+                # sweep keeps correctness, only overlap is lost this step
+                logger.exception("scheduler plan failed; executor falls "
+                                 "back to the inline sweep")
+                continue
+            if delta is None:
+                return  # server closed
+            with self._cv:
+                self._delta = delta
+            srv.stepline.observe_offthread("plan", delta.plan_s)
+
+    def _plan(self, srv) -> Optional[SchedulerDelta]:
+        # sequential with the condition above, never nested: the mutex
+        # ranks BEFORE server.scheduler in the canonical order
+        t0 = time.perf_counter()
+        with srv._mutex:  # shardlint: lock server.mutex
+            if srv._closed:
+                return None
+            now = time.perf_counter()
+            expire_queued = tuple(
+                r for r in srv._queue
+                if r.deadline_at is not None and now >= r.deadline_at
+            )
+            expire_rows = tuple(
+                (i, r) for i, r in enumerate(srv._rows)
+                if r is not None and not r.done
+                and r.deadline_at is not None and now >= r.deadline_at
+                and i not in srv._admitting_rows
+            )
+            if srv._radix is not None and srv._queue:
+                # same one-step-ahead staging the serial loop does after
+                # its dispatch: a host-tier restore rides the device queue
+                # behind the in-flight chunks
+                srv._stage_radix_plan()
+            if (
+                srv.gauge_sweep_every_s <= 0.0
+                or now - srv._last_gauge_sweep >= srv.gauge_sweep_every_s
+            ):
+                srv._sweep_gauges()
+                srv._last_gauge_sweep = now
+        return SchedulerDelta(
+            planned_at=now,
+            plan_s=time.perf_counter() - t0,
+            expire_queued=expire_queued,
+            expire_rows=expire_rows,
+        )
+
+
+class _CompletionSidecar(threading.Thread):
+    """Applies landed token logs between executor steps, so committed
+    tokens reach ``stream()``/``result()`` consumers without riding the
+    step critical path. Pops-and-applies strictly under the server mutex
+    (never holding an entry across a lock release — the settled-boundary
+    invariant), waits for the oldest in-flight log OUTSIDE any lock, and
+    re-checks after waking: the executor's own backpressure drain may have
+    consumed the entry first."""
+
+    def __init__(self, srv):
+        super().__init__(daemon=True, name="serve-exec-sidecar")
+        self._ref = weakref.ref(srv)
+        self._cv = named_lock("server.exec_sidecar", "condition")
+        self._woken = False
+        self._stopped = False
+
+    def notify(self) -> None:
+        """Wake the sidecar (executor, after dispatch, under the server
+        mutex — the condition ranks after it)."""
+        with self._cv:
+            self._woken = True
+            self._cv.notify()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify()
+
+    def run(self) -> None:
+        while True:
+            srv = self._ref()
+            if srv is None:
+                return
+            with srv._mutex:  # shardlint: lock server.mutex
+                if srv._closed:
+                    return
+                srv._drain_landed()
+                head = (
+                    srv._pending[0][1].event if srv._pending else None
+                )
+            if head is not None:
+                # oldest in-flight log: wait for it WITHOUT ownership
+                # (bounded — a racing inline drain may take it first, and
+                # stop() must not block behind a wedged transfer)
+                head.wait(0.1)
+                srv = None  # no strong ref while parked
+                with self._cv:
+                    if self._stopped:
+                        return
+                continue
+            srv = None
+            with self._cv:
+                if self._stopped:
+                    return
+                if not self._woken:
+                    self._cv.wait(0.5)
+                self._woken = False

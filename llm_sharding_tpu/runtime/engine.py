@@ -496,6 +496,7 @@ class PipelineEngine:
         top_p: float = 1.0,
         prefill_chunk: Optional[int] = None,
         pipeline_depth: int = 1,
+        inflight_steps: int = 1,
         trace_path: Optional[str] = None,
         speculate: int = 0,
         spec_ngram: int = 3,
@@ -557,6 +558,14 @@ class PipelineEngine:
         transient-retry policy, and ``snapshot_every_s=``+``snapshot_path=``
         arm periodic atomic crash-recovery checkpoints.
 
+        ``inflight_steps=N`` (N>1) turns on the ASYNC EXECUTOR
+        (``runtime/async_exec.py``): a scheduler/executor split that keeps
+        up to N decode dispatches enqueued on the device so the host-side
+        step overhead (log fetch, token apply, stream fan-out, admission
+        planning) overlaps device compute instead of serializing with it.
+        Greedy output stays token-identical at any depth; ``1`` (the
+        default) is the historical fully-serial path and the rollback.
+
         ``gauge_sweep_every_s=`` paces the per-step load/KV/attn gauge
         sweep (0, the default, sweeps every step — the historical
         behavior); the step profiler (``server.stepline``) makes the
@@ -573,6 +582,7 @@ class PipelineEngine:
             top_p=top_p,
             prefill_chunk=prefill_chunk,
             pipeline_depth=pipeline_depth,
+            inflight_steps=inflight_steps,
             trace_path=trace_path,
             speculate=speculate,
             spec_ngram=spec_ngram,

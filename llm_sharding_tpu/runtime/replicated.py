@@ -521,9 +521,21 @@ class ReplicatedServer:
         moved = failed = 0
         for req in reversed(victims):
             try:
-                st = src.extract(req)
-            except Exception as e:  # noqa: BLE001 — even extraction failed:
-                # the request cannot be saved, fail it typed
+                # failover (cause set) must NOT settle: the dead replica's
+                # log fetch would convert migratable requests into
+                # contained failures — its in-flight tokens replay on the
+                # adopter, token-identically. Elective drain() settles
+                # before calling here, and settle=True keeps any async-
+                # executor entry landed between then and this extract.
+                st = src.extract(req, settle=cause is None)
+            except Exception as e:  # noqa: BLE001 — classified below
+                if req.done and req.error is None:
+                    # the settle landed this request's final in-flight
+                    # tokens: it COMPLETED — nothing to migrate, nothing
+                    # to fail (its consumers already have the full output)
+                    continue
+                # even extraction failed: the request cannot be saved,
+                # fail it typed
                 src._fail_request(req, e)
                 REQUESTS_MIGRATED.labels(outcome="failed").inc()
                 failed += 1
@@ -618,10 +630,12 @@ class ReplicatedServer:
             self._set_replica_gauge(d, "DRAINING")
             self._retire(s)  # no new admissions from here on
             # apply every fetched-but-unapplied log first so the migrated
-            # state carries all committed tokens (elective drain runs on a
-            # healthy replica; on failure the flush is skipped — see
-            # _fail_replica — and the adopter regenerates the in-flight
-            # tokens identically)
+            # state carries all committed tokens — with the async executor
+            # (inflight_steps>1) this settles ALL overlapped in-flight
+            # dispatches, landing the migration on a settled boundary
+            # (elective drain runs on a healthy replica; on failure the
+            # flush is skipped — see _fail_replica — and the adopter
+            # regenerates the in-flight tokens identically)
             try:
                 with s._mutex:
                     s._drain(0)

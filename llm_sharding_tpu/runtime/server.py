@@ -85,6 +85,9 @@ from ..obs.stepline import StepProfiler
 from ..analysis.lockorder import named_lock
 from ..parallel import serve as serve_ops
 from ..parallel.mesh import PIPE_AXIS
+from .async_exec import (
+    INFLIGHT_STEPS, SCHEDULER_LAG, _CompletionSidecar, _StepScheduler,
+)
 from .faults import backoff_delays, is_transient
 
 logger = logging.getLogger("llm_sharding_tpu.server")
@@ -882,6 +885,7 @@ class PipelineServer:
         top_p: float = 1.0,
         prefill_chunk: Optional[int] = None,
         pipeline_depth: int = 1,
+        inflight_steps: int = 1,
         trace_path: Optional[str] = None,
         speculate: int = 0,
         spec_ngram: int = 3,
@@ -942,6 +946,24 @@ class PipelineServer:
         if pipeline_depth < 1:
             raise ValueError("pipeline_depth must be >= 1")
         self.pipeline_depth = pipeline_depth
+        # Async executor depth (runtime/async_exec.py): how many decode
+        # dispatches may stay enqueued on device before the executor
+        # applies logs inline. 1 (default) is the serial step loop —
+        # rollback from the async executor is this flag flip. N>1 splits
+        # step() into executor + off-thread scheduler + completion
+        # sidecar: the device queue never drains behind the host's
+        # fetch/apply work, generalizing pipeline_depth (which only keeps
+        # LOGS un-fetched, one dispatch per blocking step) to multiple
+        # overlapped dispatches. Greedy output stays token-identical at
+        # every depth; tokens surface up to N chunks late (the sidecar
+        # applies them between steps). Speculative decode caps the
+        # effective depth at 1 (drafts need committed ids) but keeps the
+        # scheduler/sidecar offload.
+        if inflight_steps < 1:
+            raise ValueError(
+                f"inflight_steps must be >= 1, got {inflight_steps}"
+            )
+        self.inflight_steps = int(inflight_steps)
         # Speculative decoding (runtime/spec.py + parallel/serve.serve_verify):
         # speculate=K replaces the interleaved serve_chunk decode with
         # per-slot verify traversals — the host n-gram-drafts up to K tokens
@@ -1266,6 +1288,24 @@ class PipelineServer:
             )
         self.gauge_sweep_every_s = float(gauge_sweep_every_s)
         self._last_gauge_sweep = 0.0  # perf_counter of the last in-step sweep
+        # a LOWER BOUND on the earliest live deadline (None = no armed
+        # deadline): enqueue sites tighten it, _shed_expired recomputes it
+        # exactly. The async executor sweeps inline only when it has
+        # passed — the serial contract (expired rows cancelled at the NEXT
+        # chunk boundary) must not depend on scheduler-thread timing, and
+        # a bound that only ever undershoots can never miss an expiry.
+        self._deadline_hint: Optional[float] = None
+        # async-executor helper threads, started only at depth > 1 (they
+        # hold a weakref to the server and need the mutex above — so this
+        # block stays after every attribute they read exists)
+        self._scheduler: Optional[_StepScheduler] = None
+        self._sidecar: Optional[_CompletionSidecar] = None
+        if self.inflight_steps > 1:
+            self._scheduler = _StepScheduler(self)
+            self._sidecar = _CompletionSidecar(self)
+            self._scheduler.start()
+            self._sidecar.start()
+        INFLIGHT_STEPS.set(float(self.inflight_steps))
         # register LAST: a concurrent gauge sweep from another serving
         # thread must never see a half-constructed server (_alloc,
         # _mirror_len, _queue, _rows are all read by _update_load_gauges)
@@ -1417,6 +1457,7 @@ class PipelineServer:
             if top_k > 0 or top_p < 1.0:
                 self._filtering = True
             self._queue.append(req)
+            self._arm_deadline(req.deadline_at)
             self.counters.inc("requests_submitted")
             _update_load_gauges()
         logger.info(
@@ -1570,14 +1611,15 @@ class PipelineServer:
                 return d
 
             return {
-                # format 4: adds kv_dtype to serve_kwargs, the scale-arena
-                # state leaves and the radix host-KV component keys
-                # (radix.{i}.kv{j}) — bumped so a PRE-kv-quant reader's
-                # format gate refuses cleanly instead of crashing on the
-                # unknown kwarg. Format 3 added the prefix-cache section;
-                # formats 1 (dense), 2 (paged, no cache) and 3 still
-                # restore — see ``restore``
-                "format": 4,
+                # format 5: adds inflight_steps to serve_kwargs (the async
+                # executor depth rides the checkpoint like every serve
+                # kwarg — snapshot-wins on restore) — bumped so a pre-
+                # async-executor reader's format gate refuses cleanly
+                # instead of crashing on the unknown kwarg. Format 4 added
+                # kv_dtype + the scale-arena/radix host-KV keys, format 3
+                # the prefix-cache section; formats 1 (dense) through 4
+                # still restore — see ``restore``
+                "format": 5,
                 "radix": (
                     None if self._radix is None else self._radix.snapshot()
                 ),
@@ -1589,6 +1631,7 @@ class PipelineServer:
                     top_p=self.top_p,
                     prefill_chunk=self.prefill_chunk,
                     pipeline_depth=self.pipeline_depth,
+                    inflight_steps=self.inflight_steps,
                     speculate=self.speculate,
                     spec_ngram=self.spec_ngram,
                     max_queue=self.max_queue,
@@ -1646,7 +1689,7 @@ class PipelineServer:
         of an unsupported model family, raises the curated
         ``NotImplementedError`` instead of an obscure mesh/sharding error
         deep in the first dispatched program."""
-        if snap.get("format") not in (1, 2, 3, 4):
+        if snap.get("format") not in (1, 2, 3, 4, 5):
             raise ValueError(f"unknown snapshot format {snap.get('format')!r}")
         validate = getattr(engine, "_validate_serve", None)
         if validate is not None:
@@ -1922,6 +1965,7 @@ class PipelineServer:
             if top_k > 0 or top_p < 1.0:
                 self._filtering = True
             self._queue.append(req)
+            self._arm_deadline(req.deadline_at)
             self.counters.inc("requests_submitted")
             _update_load_gauges()
         logger.info(
@@ -1960,7 +2004,22 @@ class PipelineServer:
         failure is contained to its affected requests (health drops to
         DEGRADED) and the daemon keeps stepping — a subsequent clean
         productive step restores SERVING. With auto-snapshot armed the step
-        ends by checkpointing once per interval. A closed server no-ops."""
+        ends by checkpointing once per interval. A closed server no-ops.
+
+        With ``inflight_steps=N>1`` the serial body below is replaced by
+        the async executor (``_step_async``): up to N decode dispatches
+        stay enqueued on device, the deadline sweep / radix staging /
+        gauge sweep move onto the scheduler thread's published delta, and
+        token apply moves onto the completion sidecar — the hot loop is
+        publish → admit → dispatch, with inline draining only at the
+        in-flight cap. Greedy output is token-identical at every depth."""
+        if self.inflight_steps > 1:
+            return self._step_async()
+        return self._step_serial()
+
+    def _step_serial(self) -> bool:
+        """The historical single-threaded step body (``inflight_steps=1``):
+        see ``step`` for the full contract."""
         with self._mutex:
             if self._closed:
                 return False
@@ -1999,7 +2058,12 @@ class PipelineServer:
                 applied = self._drain(0)
             dt_apply = time.perf_counter() - t0
             if progressed or applied:
+                # span emission is real per-step host work (the flight
+                # recorder ring write) — attribute it to the apply phase
+                # it reports on instead of leaving it unattributed
+                sl.push("apply")
                 self._span("apply", dur_s=dt_apply, applied=applied)
+                sl.pop()
                 now = time.perf_counter()
                 if (
                     self.gauge_sweep_every_s <= 0.0
@@ -2050,6 +2114,193 @@ class PipelineServer:
         if snap_due is not None:
             self._write_autosnapshot(snap_due)
         return progressed
+
+    def _step_async(self) -> bool:
+        """The async executor's hot loop (``inflight_steps=N>1``): apply
+        the scheduler's published delta, admit, dispatch — and drain
+        inline only when the in-flight window is full or the server went
+        passive. Stepline phases: ``publish`` (delta consumption, with
+        the inline ``_shed_expired`` fallback when the scheduler hasn't
+        published), ``admit``, ``dispatch``, and ``drain`` (the inline
+        settle, with the historical ``fetch``/``apply`` sub-phases nested
+        disjointly inside); the scheduler's overlapped ``plan`` time
+        reaches the phase histogram off-thread and deliberately stays out
+        of StepRecords, so the exact-accounting invariant holds unchanged.
+
+        The step ends by kicking the scheduler (plan the next boundary)
+        and waking the sidecar (apply whatever lands while the pump is
+        between steps). Both notifies happen under the mutex — their
+        conditions rank after it in the canonical lock order."""
+        sched, sidecar = self._scheduler, self._sidecar
+        with self._mutex:
+            if self._closed:
+                return False
+            sl = self.stepline
+            sl.begin_step()
+            tok0 = self.counters.tokens_generated
+            # NOT reset here (unlike the serial loop): the sidecar may
+            # have contained a failure BETWEEN steps — that containment
+            # must suppress this step's health recovery exactly like an
+            # in-step one, so DEGRADED stays observable for at least one
+            # full step boundary at any depth. Consumed at step end.
+            sl.push("publish")
+            delta = sched.take() if sched is not None else None
+            if delta is not None:
+                progressed = self._apply_delta(delta)
+                if (
+                    self._deadline_hint is not None
+                    and time.perf_counter() >= self._deadline_hint
+                ):
+                    # staleness backstop: a deadline passed AFTER the
+                    # delta was planned (it can be one boundary old) —
+                    # sweep inline so expiry still lands at this chunk
+                    # boundary, exactly like the serial loop. Costs
+                    # nothing until a deadline has actually passed.
+                    progressed |= self._shed_expired()
+            else:
+                # scheduler hasn't published (first step, or it lost the
+                # race for the mutex): the inline sweep keeps deadline
+                # correctness independent of thread timing
+                progressed = self._shed_expired()
+            sl.pop()
+            sl.push("admit")
+            if self._queue and self._free_slots():
+                # admission needs accurate mirrors → land every in-flight
+                # log first (same stale-mirror gate as the serial loop)
+                self._drain(0)
+                progressed |= self._admit_pending()
+            sl.pop()
+            if self.speculate and self._any_active():
+                # effective in-flight depth 1: the next step's drafts need
+                # this verify's committed ids — the async win here is only
+                # the scheduler/sidecar offload
+                sl.push("dispatch")
+                self._spec_step()
+                sl.pop()
+                progressed = True
+                t0 = time.perf_counter()
+                sl.push("drain")
+                applied = self._drain(0)
+                sl.pop()
+            elif self._any_active():
+                # backpressure BEFORE dispatch: cap un-applied logs at
+                # inflight_steps-1 so the dispatch below tops the window
+                # up to exactly inflight_steps. In steady state the
+                # sidecar has already landed these and this drain pops
+                # nothing — the executor only blocks when the sidecar
+                # fell a full window behind.
+                t0 = time.perf_counter()
+                sl.push("drain")
+                applied = self._drain(self.inflight_steps - 1)
+                sl.pop()
+                self._dispatch_chunk()
+                progressed = True
+            else:
+                t0 = time.perf_counter()
+                sl.push("drain")
+                applied = self._drain(0)
+                sl.pop()
+            dt_apply = time.perf_counter() - t0
+            if progressed or applied:
+                # same attribution as the serial loop: the span's flight-
+                # recorder write is apply-phase work, not step slop
+                sl.push("apply")
+                self._span("apply", dur_s=dt_apply, applied=applied)
+                sl.pop()
+            # NOT here at depth>1: gauge sweep + radix staging — the
+            # scheduler thread does both off the critical path (_plan)
+            snap_due = self._capture_autosnapshot()
+            if (
+                self._health == DEGRADED
+                and not self._step_contained
+                and (
+                    progressed or applied
+                    or not (
+                        self._queue or self._any_active() or self._pending
+                    )
+                )
+            ):
+                self._set_health(SERVING)
+            self._step_contained = False  # consumed: the next boundary
+            # may recover (the serial loop resets at step START instead —
+            # it has no between-step appliers)
+            sl.end_step(
+                rows=sum(
+                    1 for r in self._rows if r is not None and not r.done
+                ),
+                tokens=self.counters.tokens_generated - tok0,
+                queued=len(self._queue),
+                pending=len(self._pending),
+            )
+            if sched is not None:
+                sched.kick()
+            if sidecar is not None and self._pending:
+                sidecar.notify()
+        if snap_due is not None:
+            self._write_autosnapshot(snap_due)
+        return progressed
+
+    def _apply_delta(self, delta) -> bool:
+        """Act on the scheduler's published delta at a step boundary
+        (mutex held). Every candidate is RE-VALIDATED against live state:
+        plan-time state may be stale by apply time (the request finished,
+        admitted, or was cancelled in between), and a newly-expired
+        request the plan missed is caught by the next delta — the
+        one-boundary staleness ``server_scheduler_lag_seconds`` bounds."""
+        now = time.perf_counter()
+        SCHEDULER_LAG.observe(now - delta.planned_at)
+        shed = False
+        if delta.expire_queued:
+            doomed = {
+                id(r) for r in delta.expire_queued
+                if not r.done and r.deadline_at is not None
+                and now >= r.deadline_at
+            }
+            if doomed:
+                keep: collections.deque = collections.deque()
+                for r in self._queue:
+                    if id(r) in doomed:
+                        _M_DEADLINE.labels(where="queued").inc()
+                        self._fail_request(r, DeadlineExceeded(
+                            f"request {r.id} expired after "
+                            f"{now - r.submitted_at:.3f}s in queue"
+                        ))
+                        shed = True
+                    else:
+                        keep.append(r)
+                self._queue = keep
+        expired = [
+            (i, r) for i, r in delta.expire_rows
+            if self._rows[i] is r and not r.done
+            and r.deadline_at is not None and now >= r.deadline_at
+            and i not in self._admitting_rows
+        ]
+        if expired:
+            try:
+                self._cancel_rows([i for i, _ in expired])
+            except Exception:  # noqa: BLE001 — same guard as the inline
+                # sweep: the requests still fail host-side, the device
+                # rows run to budget exhaustion and free
+                logger.exception(
+                    "deadline cancel dispatch failed for rows %s",
+                    [i for i, _ in expired],
+                )
+            for i, r in expired:
+                _M_DEADLINE.labels(where="in_flight").inc()
+                self._fail_request(r, DeadlineExceeded(
+                    f"request {r.id} expired mid-decode "
+                    f"({len(r.tokens)}/{r.max_new} tokens)"
+                ))
+            shed = True
+        if shed:
+            _update_load_gauges()
+        return shed
+
+    def _sweep_gauges(self) -> None:
+        """Scheduler-thread hook for the paced load-gauge sweep (the
+        module-level ``_update_load_gauges`` is not importable from
+        ``async_exec`` without a cycle)."""
+        _update_load_gauges()
 
     def _dispatch_chunk(self) -> None:
         """Dispatch one interleaved decode chunk, retrying transient
@@ -2265,6 +2516,13 @@ class PipelineServer:
             _update_load_gauges()
             if self._trace is not None:
                 self._trace.close()
+        # async-executor threads: signal outside the mutex (their loops
+        # re-check _closed under it) and join bounded — a parked thread
+        # wakes within its condition-wait timeout
+        for t in (self._scheduler, self._sidecar):
+            if t is not None:
+                t.stop()
+                t.join(timeout=2.0)
         logger.info("server closed")
 
     def cancel(self, req: Request) -> bool:
@@ -2795,7 +3053,9 @@ class PipelineServer:
 
     # ------------------------------------ live migration (dp supervision)
 
-    def extract(self, req: Request) -> RequestState:
+    def extract(
+        self, req: Request, *, settle: Optional[bool] = None
+    ) -> RequestState:
         """Pull a LIVE request off this server as portable host-side state
         (``RequestState``) WITHOUT failing it: the request leaves the queue
         or its slot row (device cancel is best-effort — a dead replica's
@@ -2811,11 +3071,30 @@ class PipelineServer:
         consumers saw (a dispatched-but-unapplied chunk's tokens were never
         yielded; the adopter simply regenerates them, token-identically).
 
+        ``settle``: with the async executor (``inflight_steps>1``) several
+        chunks' tokens may be in flight — settling (``_drain(0)``) first
+        lands them so the migrated state carries every token the device
+        already computed instead of re-generating them on the adopter.
+        ``None`` (default) settles exactly when it can succeed: a healthy
+        (SERVING) async server with pending logs. Failover passes
+        ``settle=False`` — a dead replica's fetch would only convert
+        migratable requests into contained failures; its in-flight tokens
+        REPLAY on the adopter, token-identically, which is the documented
+        drain-or-replay contract.
+
         On a SPECULATIVE sampled server the device chain advances per
         verify step, not per token, so the recomputed chain is a fresh
         deterministic continuation rather than the unfaulted run's exact
         draws (greedy spec rows stay token-identical either way)."""
         with self._mutex:
+            if settle is None:
+                settle = (
+                    self.inflight_steps > 1
+                    and self._health == SERVING
+                    and not self._closed
+                )
+            if settle and self._pending and not req.done:
+                self._drain(0)
             if req.done:
                 raise ValueError(
                     f"request {req.id} is finished; nothing to extract"
@@ -3003,6 +3282,7 @@ class PipelineServer:
                 self._queue.appendleft(req)
             else:
                 self._queue.append(req)
+            self._arm_deadline(req.deadline_at)
             self._span(
                 "adopt", req=req, resumed_prompt=req.prompt_len,
                 remaining=remaining,
@@ -3166,6 +3446,17 @@ class PipelineServer:
             ]
         self._contain_rows("log_fetch", victims, err)
 
+    def _arm_deadline(self, deadline_at: Optional[float]) -> None:
+        """Tighten ``_deadline_hint`` for a request entering the queue
+        (mutex held): the hint stays a lower bound on the earliest live
+        deadline, so the async executor's inline backstop sweep fires at
+        (or before) every actual expiry without scanning per step."""
+        if deadline_at is not None and (
+            self._deadline_hint is None
+            or deadline_at < self._deadline_hint
+        ):
+            self._deadline_hint = deadline_at
+
     def _shed_expired(self) -> bool:
         """Deadline sweep, start of every step: expired queued requests are
         shed before they ever cost a prefill; expired in-flight rows are
@@ -3215,6 +3506,16 @@ class PipelineServer:
             shed = True
         if shed:
             _update_load_gauges()
+        # the sweep touched every live request anyway — recompute the
+        # hint exactly so the async executor's backstop stops firing
+        # until the next real deadline approaches
+        hints = [
+            r.deadline_at for r in self._queue if r.deadline_at is not None
+        ] + [
+            r.deadline_at for r in self._rows
+            if r is not None and not r.done and r.deadline_at is not None
+        ]
+        self._deadline_hint = min(hints) if hints else None
         return shed
 
     def _capture_autosnapshot(self) -> Optional[dict]:
@@ -3989,29 +4290,52 @@ class PipelineServer:
                 tb = time.perf_counter()
                 entry[1].event.wait()
                 sl.blocked(time.perf_counter() - tb)
-            try:
-                value = self._retry(
-                    "log_fetch",
-                    lambda e=entry: (
-                        self._fault_check("log_fetch"), e[1].get_retryable()
-                    )[1],
-                )
-            except Exception as err:  # noqa: BLE001 — the log is lost
-                self._contain_lost_log(entry, err)
-                continue
-            sl.push("apply")
-            if entry[0] == "chunk":
-                self._apply_log(value, entry[2])
-            elif entry[0] == "spec":
-                self._apply_spec(value, entry[2])
-            else:  # "admit": per-row first tokens from serve_admit
-                for i, (row, req) in enumerate(entry[2]):
-                    if req.done or self._rows[row] is not req:
-                        continue  # cancelled between dispatch and drain
-                    self._apply_token(row, req, int(value[i]))
-            sl.pop()
+            self._apply_entry(entry)
         sl.pop()
         return applied
+
+    def _drain_landed(self) -> int:
+        """Sidecar drain (mutex held): apply every in-flight entry whose
+        log has already LANDED on host, oldest first, stopping at the
+        first still-in-flight one — applies are ordered and this path
+        never blocks. The builder calls inside ``_apply_entry`` no-op
+        safely here: the mutex guarantees the pump is between steps, so
+        the profiler has no open step."""
+        applied = 0
+        while self._pending and self._pending[0][1].event.is_set():
+            self._apply_entry(self._pending.popleft())
+            applied += 1
+        return applied
+
+    def _apply_entry(self, entry) -> bool:
+        """Fetch (with retry/containment) and apply ONE popped ``_pending``
+        entry; shared by the blocking ``_drain`` and the sidecar's
+        ``_drain_landed``. Returns False when the log was lost and its
+        requests were failed (``_contain_lost_log``) — draining continues
+        with the next entry either way."""
+        sl = self.stepline
+        try:
+            value = self._retry(
+                "log_fetch",
+                lambda e=entry: (
+                    self._fault_check("log_fetch"), e[1].get_retryable()
+                )[1],
+            )
+        except Exception as err:  # noqa: BLE001 — the log is lost
+            self._contain_lost_log(entry, err)
+            return False
+        sl.push("apply")
+        if entry[0] == "chunk":
+            self._apply_log(value, entry[2])
+        elif entry[0] == "spec":
+            self._apply_spec(value, entry[2])
+        else:  # "admit": per-row first tokens from serve_admit
+            for i, (row, req) in enumerate(entry[2]):
+                if req.done or self._rows[row] is not req:
+                    continue  # cancelled between dispatch and drain
+                self._apply_token(row, req, int(value[i]))
+        sl.pop()
+        return True
 
     def _apply_log(self, log: np.ndarray, m0: int) -> None:
         """Replay one chunk's token log into the host mirrors. At microstep
