@@ -1039,6 +1039,191 @@ def bench_async_exec(on_tpu, engine):
     gc.collect()
 
 
+def bench_cp_serve(on_tpu, engine):
+    """ISSUE 18 headline: context-parallel long-context serving. The paged
+    arena shards across ``cp`` chip groups (one sub-arena + allocator
+    partition + block-table plane per shard), chunked prefill lands KV
+    arena-native on its owner shard, and decode combines per-shard
+    attention partials with the online-softmax recurrence — so at EQUAL
+    per-shard arena, cp=2 must admit a prompt bucket the cp=1 pool's
+    never-fits check refuses. That strictly-larger-admissible bound is the
+    feature's contract and is gated HARD wherever the mesh is real (TPU,
+    or a multi-core host driving >= 2 virtual devices); greedy output must
+    be token-identical between cp=1 and cp=2 on the same seeded workload
+    (divergence raises everywhere — a longer-but-wrong context must not
+    ship). The emitted value is cp=2 steady-state decode tok/s;
+    vs_baseline is the cp=2/cp=1 ratio on the same workload, i.e. the
+    measured cost of the cross-shard combine + per-chunk table push (< 1.0
+    is expected and honest: cp buys CONTEXT, not short-context speed).
+    TTFT p50 rides as extras at the shared bucket and at the cp=2-only
+    long bucket (32k on TPU, 512 in the CPU smoke)."""
+    from llm_sharding_tpu.runtime.engine import PipelineEngine
+    from llm_sharding_tpu.runtime.server import ADMIT_BUCKETS
+    import jax as _jax
+
+    name = (
+        "serve_tok_s_cp2_llama3.2-3b_1stage" if on_tpu
+        else "serve_tok_s_cp2_tiny_cpu"
+    )
+    n_dev = len(_jax.devices())
+    if n_dev < 2:
+        raise RuntimeError(
+            f"not attempted: cp=2 needs >= 2 devices (have {n_dev})"
+        )
+    host_cores = os.cpu_count() or 1
+    strict = on_tpu or host_cores >= 2
+    if on_tpu:
+        # 384 usable blocks/shard x 64-token blocks = 24576 slots/shard:
+        # bucket 16384 fits one shard (257 blocks), 32768 needs 513 — over
+        # one shard, under two. capacity covers 32768 + decode headroom.
+        bs, per_shard = 64, 385
+        capacity, chunk = 33280, 2048
+        rows, work_len, work_new = 8, 512, 32
+        probe_new, ttft_new = 8, 4
+    else:
+        # own tiny engine: the shared CPU smoke config tops out at 128
+        # positions — long-context admission needs real bucket headroom
+        from llm_sharding_tpu.models.config import tiny_llama
+        from llm_sharding_tpu.models import llama as _llama
+        import jax.numpy as _jnp
+
+        cfg2 = tiny_llama(num_hidden_layers=2,
+                          max_position_embeddings=2048)
+        engine = PipelineEngine(
+            cfg2, _llama.init_params(cfg2, _jax.random.key(5),
+                                     dtype=_jnp.float32),
+            num_stages=1, host_staging=False, cache_dtype=_jnp.float32,
+        )
+        # 32 usable blocks/shard x 16-token blocks = 512 slots/shard:
+        # bucket 256 fits one shard (17 blocks at max_new 4), 512 needs
+        # 33 — over one shard, under two
+        bs, per_shard = 16, 33
+        capacity, chunk = 2048, 128
+        rows, work_len, work_new = 4, 48, 12
+        probe_new, ttft_new = 4, 2
+    cfg = engine.cfg
+    rng = np.random.default_rng(71)
+    work_prompts = [
+        rng.integers(0, cfg.vocab_size, work_len).astype(np.int32)
+        for _ in range(rows)
+    ]
+
+    def serve(cp):
+        return engine.serve(
+            capacity=capacity, batch_per_slot=rows, kv_block_size=bs,
+            kv_blocks=per_shard, prefill_chunk=chunk, cp=cp,
+        )
+
+    def probe_max_admissible(srv):
+        """Walk the admit-bucket ladder submitting (then cancelling — the
+        never-fits check is a submit-time static bound, no prefill runs)
+        until the pool refuses: the largest admitted bucket IS the server's
+        admissible context at this per-shard arena."""
+        top = 0
+        for L in ADMIT_BUCKETS:
+            if L + probe_new + 1 > min(capacity,
+                                       cfg.max_position_embeddings):
+                break
+            p = rng.integers(0, cfg.vocab_size, L).astype(np.int32)
+            try:
+                r = srv.submit(p, max_new_tokens=probe_new)
+            except ValueError:
+                break
+            srv.cancel(r)
+            top = L
+        return top
+
+    def ttft_p50(srv, L, reps=4):
+        """Submit→first-token wall p50; the first rep pays the bucket's
+        compile (chunk count is bucket-dependent) and is dropped."""
+        vals = []
+        for _ in range(reps):
+            p = rng.integers(0, cfg.vocab_size, L).astype(np.int32)
+            t0 = time.perf_counter()
+            r = srv.submit(p, max_new_tokens=ttft_new)
+            while not r.tokens:
+                srv.step()
+            vals.append(time.perf_counter() - t0)
+            while not r.done:
+                srv.step()
+        return float(np.median(vals[1:]))
+
+    def throughput(srv):
+        warm = srv.submit(work_prompts[0], max_new_tokens=work_new)
+        while not warm.done:
+            srv.step()
+        reqs = [srv.submit(p, max_new_tokens=work_new)
+                for p in work_prompts]
+        t0 = time.perf_counter()
+        while not all(r.done for r in reqs):
+            srv.step()
+        dt = time.perf_counter() - t0
+        assert all(r.error is None for r in reqs), [
+            (r.id, r.error) for r in reqs if r.error is not None
+        ]
+        toks = [list(r.tokens) for r in reqs]
+        return toks, sum(len(t) for t in toks) / dt
+
+    # cp=1 first: its max admissible bucket is the shared TTFT point
+    srv1 = serve(1)
+    max1 = probe_max_admissible(srv1)
+    ttft1 = ttft_p50(srv1, max1)
+    toks1, tok_s1 = throughput(srv1)
+    srv1._alloc.check()
+    srv1.close()
+    del srv1
+    gc.collect()
+
+    srv2 = serve(2)
+    max2 = probe_max_admissible(srv2)
+    ttft2_shared = ttft_p50(srv2, max1)
+    ttft2_long = ttft_p50(srv2, max2) if max2 > max1 else None
+    toks2, tok_s2 = throughput(srv2)
+    srv2._alloc.check()
+    srv2.close()
+    del srv2
+    if not on_tpu:
+        del engine
+    gc.collect()
+
+    if toks2 != toks1:
+        raise RuntimeError(
+            f"cp=2 greedy output diverged from cp=1 on the same workload "
+            f"({sum(len(t) for t in toks2)} vs "
+            f"{sum(len(t) for t in toks1)} tokens)"
+        )
+    gate_larger = max2 > max1
+    if strict and not gate_larger:
+        raise RuntimeError(
+            f"cp=2 admissible bucket ({max2}) is not strictly larger than "
+            f"cp=1's ({max1}) at equal per-shard arena ({per_shard} blocks "
+            f"x {bs} tokens) — the sharded pool bought no context"
+        )
+    extra_long = (
+        {"ttft_p50_ms_cp2_long": round(ttft2_long * 1e3, 2)}
+        if ttft2_long is not None else {}
+    )
+    emit(
+        name, tok_s2, "tokens/sec", tok_s2 / max(tok_s1, 1e-9),
+        cp1_tok_s=round(tok_s1, 2),
+        rows=rows,
+        max_admissible_cp1=max1,
+        max_admissible_cp2=max2,
+        kv_blocks_per_shard=per_shard,
+        kv_block_size=bs,
+        ttft_p50_ms_cp1=round(ttft1 * 1e3, 2),
+        ttft_p50_ms_cp2=round(ttft2_shared * 1e3, 2),
+        # in-band gates: identity raises above; the admissible bound is
+        # HARD (raise) on TPU or a multi-core host, advisory otherwise
+        host_cores=host_cores,
+        gates_enforced=bool(strict),
+        gate_larger_admissible=bool(gate_larger),
+        token_identical=True,
+        **extra_long,
+    )
+    gc.collect()
+
+
 def bench_failover_serve(on_tpu, cfg, params, jax, jnp):
     """Throughput DURING a replica failover vs the clean dp run. A seeded
     ``replica_step`` fault kills replica 0 mid-decode; the supervision
@@ -2213,6 +2398,17 @@ def bench_pallas(on_tpu, jax, jnp):
 
 
 def main():
+    # BEFORE the first jax import: force 8 virtual host devices. Inert on
+    # TPU (the flag only sizes the host-platform backend, and TPU sections
+    # pin their device lists explicitly); on the CPU smoke it makes the
+    # multi-device sections real — cp=2 arena sharding gets an actual
+    # 2-device mesh, and the dp sections (failover/disagg) run a true
+    # replica mesh instead of emitting "needs >= N devices" error lines.
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
     import jax
     import jax.numpy as jnp
 
@@ -2302,6 +2498,10 @@ def main():
     nasync = (
         "serve_async_exec_tok_s_llama3.2-3b_1stage" if on_tpu
         else "serve_async_exec_tok_s_tiny_cpu"
+    )
+    ncp = (
+        "serve_tok_s_cp2_llama3.2-3b_1stage" if on_tpu
+        else "serve_tok_s_cp2_tiny_cpu"
     )
 
     # section order = survival priority under a driver-side timeout:
@@ -2491,6 +2691,21 @@ def main():
                 bench_async_exec(on_tpu, serve_engine)
             except Exception as e:  # noqa: BLE001
                 emit_error(nasync, "tokens/sec", e)
+        # context-parallel serving (ISSUE 18: sharded arena — admissible
+        # context growth + TTFT, cp1/cp2 identity gated in-band). On TPU
+        # it reuses the live serve engine; the CPU smoke builds its own
+        # long-position tiny engine inside the section.
+        if on_tpu and serve_engine is None:
+            emit_error(ncp, "tokens/sec",
+                       "not attempted: serve engine unavailable")
+        elif remaining() < 240:
+            emit_skip(ncp, "tokens/sec", 240)
+        else:
+            try:
+                bench_cp_serve(on_tpu, serve_engine)
+            except Exception as e:  # noqa: BLE001
+                emit_error(ncp, "tokens/sec", e)
+            gc.collect()
         # replica failover (dp2 supervision: kill one replica mid-decode,
         # throughput through migration vs clean) builds its OWN replica
         # engines from params3b — run before int8 donates those buffers
@@ -2584,6 +2799,19 @@ def main():
         emit_error(nocc, "percent_of_step_wall",
                    "not attempted: 3B section failed")
         emit_error(nasync, "tokens/sec", "not attempted: 3B section failed")
+        # the CPU cp section is self-contained (own tiny engine) — only
+        # the TPU variant rides the 3B serve engine
+        if on_tpu:
+            emit_error(ncp, "tokens/sec",
+                       "not attempted: 3B section failed")
+        elif remaining() < 240:
+            emit_skip(ncp, "tokens/sec", 240)
+        else:
+            try:
+                bench_cp_serve(on_tpu, None)
+            except Exception as e:  # noqa: BLE001
+                emit_error(ncp, "tokens/sec", e)
+            gc.collect()
         emit_error(nprefix, "x_speedup_vs_full_prefill",
                    "not attempted: 3B section failed")
         emit_error(nspec, "tokens/sec", "not attempted: 3B section failed")

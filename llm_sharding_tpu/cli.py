@@ -300,6 +300,7 @@ def _serve_control(eng, srv, line: str, args):
                     srv.host_pool_blocks if srv.prefix_cache == "host" else 0
                 ),
                 gauge_sweep_every_s=srv.gauge_sweep_every_s,
+                cp=srv.cp,
             )
 
         try:
@@ -493,6 +494,32 @@ def cmd_serve(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if getattr(args, "cp", 1) > 1:
+        # same fast-fail-before-model-load pattern: PipelineServer and
+        # PipelineEngine.serve validate all of these too, but only after
+        # minutes of checkpoint loading
+        cp_bad = None
+        if not args.kv_block_size:
+            cp_bad = ("--cp needs paged KV serving "
+                      "(--kv-block-size/--kv-blocks): context parallelism "
+                      "shards the paged arena")
+        elif getattr(args, "data_parallel", 1) > 1:
+            cp_bad = "--cp with --data-parallel is not supported yet"
+        elif getattr(args, "tensor_parallel", 1) > 1:
+            cp_bad = "--cp with --tensor-parallel is not supported yet"
+        elif getattr(args, "disagg", False):
+            cp_bad = ("--cp with --disagg is not supported yet (cp-aware "
+                      "KV hand-off streaming is a roadmap item)")
+        elif getattr(args, "speculate", 0):
+            cp_bad = "--cp with --speculate is not supported yet"
+        elif (getattr(args, "prefix_cache", "off") != "off"
+              and not args.prefill_chunk):
+            cp_bad = ("--cp with --prefix-cache needs --prefill-chunk: "
+                      "radix hits admit through the chunked ring-prefill "
+                      "path under context parallelism")
+        if cp_bad:
+            print(f"error: {cp_bad}", file=sys.stderr)
+            return 2
     if getattr(args, "tenants_config", None) and not getattr(
         args, "http_port", 0
     ):
@@ -795,6 +822,7 @@ def cmd_serve(args) -> int:
                 prefix_cache=getattr(args, "prefix_cache", "off"),
                 host_pool_blocks=getattr(args, "host_pool_blocks", 0),
                 gauge_sweep_every_s=getattr(args, "gauge_sweep_every", 0.0),
+                cp=getattr(args, "cp", 1),
             )
         # srv.capacity, not args.capacity: after --restore the daemon runs
         # at the SNAPSHOT's serve_kwargs (ADVICE r5 — the banner used to
@@ -1442,6 +1470,15 @@ def build_parser() -> argparse.ArgumentParser:
         "--tensor-parallel", type=int, default=1, dest="tensor_parallel",
         help="megatron tensor parallelism per pipeline (composes with "
         "--stages and --data-parallel: devices = dp x stages x tp)",
+    )
+    s.add_argument(
+        "--cp", type=int, default=1,
+        help="context parallelism for long-context serving (with "
+        "--kv-block-size/--kv-blocks): shard the paged KV arena across N "
+        "chip groups so the admissible context grows ~N-fold at fixed "
+        "per-chip HBM (devices = cp x stages). Chunked prefill runs ring "
+        "passes over shard-resident KV and decode combines per-shard "
+        "attention partials; greedy output stays token-identical to cp=1",
     )
     s.add_argument(
         "--min-replicas", type=int, default=1, dest="min_replicas",

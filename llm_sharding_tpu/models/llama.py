@@ -226,6 +226,7 @@ def paged_decoder_layer(
     prefill: bool = False,  # static: chunk-shaped queries — attend via
     #   the query-tiled paged_prefill kernel instead of the decode one
     nlive: Optional[jnp.ndarray] = None,  # [B] prefill traffic clamp
+    cp_axis: Optional[str] = None,  # context-parallel combine axis
 ):
     """Decode-path layer over the pooled arena: the step's fresh KV lands
     via a block-indexed scatter and attention streams exactly the blocks
@@ -237,9 +238,18 @@ def paged_decoder_layer(
     flash-style chunked-prefill kernel whose query axis is the whole
     chunk (``nlive`` bounds its KV streaming to each row's written
     frontier); write-then-attend order is identical, so intra-chunk
-    causality falls out of the position masking either way."""
+    causality falls out of the position masking either way.
+
+    ``cp_axis`` (context-parallel serving, ``serve(cp=N)``): the arena
+    this layer sees is ONE SHARD of the pooled blocks and the table maps
+    only locally-owned columns (unowned → the shard's trash block, which
+    absorbs this step's unowned writes). Attention then emits partial
+    ``(acc, m, l)`` softmax statistics over the local blocks and
+    ``combine_attn_stats`` reduces them across ``cp_axis`` with the
+    flash recurrence — the combined output equals attention over the
+    full window, so everything downstream stays shard-replicated."""
     from ..ops.paged_attention import (
-        paged_attention, paged_prefill, write_block_kv,
+        combine_attn_stats, paged_attention, paged_prefill, write_block_kv,
     )
 
     out = {}
@@ -257,15 +267,19 @@ def paged_decoder_layer(
                 valid=write_valid & valid, k_scale=k_scale, v_scale=v_scale,
             )
             out["kv"] = (k_a, v_a, ks, vs)
-        if prefill:
-            return paged_prefill(
+        dispatch = paged_prefill if prefill else paged_attention
+        kw = dict(nlive=nlive) if prefill else {}
+        if cp_axis is not None:
+            acc, m, l = dispatch(
                 q, k_a, v_a, block_table, positions, kv_positions,
                 backend=backend, k_scale=out["kv"][2],
-                v_scale=out["kv"][3], nlive=nlive,
+                v_scale=out["kv"][3], stats=True, **kw,
             )
-        return paged_attention(
+            return combine_attn_stats(acc, m, l, cp_axis).astype(q.dtype)
+        return dispatch(
             q, k_a, v_a, block_table, positions, kv_positions,
             backend=backend, k_scale=out["kv"][2], v_scale=out["kv"][3],
+            **kw,
         )
 
     h = attn_mlp_block(cfg, p, h, cos, sin, attn_fn, tp_axis)
@@ -291,6 +305,8 @@ def forward_layers_paged(
     prefill: bool = False,  # static: chunked-prefill traversal (see
     #   paged_decoder_layer) — queries are a whole prompt chunk
     nlive: Optional[jnp.ndarray] = None,  # [B] prefill traffic clamp
+    cp_axis: Optional[str] = None,  # context-parallel combine axis (the
+    #   arena/table are per-shard; see paged_decoder_layer)
 ):
     """Paged counterpart of ``forward_layers`` for the serve decode path:
     scans the layer stack over the pooled arena (``stack.scan_layers_paged``)
@@ -309,6 +325,7 @@ def forward_layers_paged(
             cfg, p, valid, h, k_l, v_l, block_table, cols, cos, sin,
             positions, kv_positions, wv, tp_axis, backend,
             k_scale=ks_l, v_scale=vs_l, prefill=prefill, nlive=nlive,
+            cp_axis=cp_axis,
         )
 
     return scan_layers_paged(

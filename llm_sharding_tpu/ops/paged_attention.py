@@ -281,6 +281,98 @@ def paged_attention_xla(
     return cached_attention(q, k, v, q_positions, kv_positions, scale)
 
 
+def attn_stats_xla(
+    q: jnp.ndarray,  # [B, S, Nh, D] (RoPE'd)
+    k_arena: jnp.ndarray,  # [NB, BS, Nkv, D]
+    v_arena: jnp.ndarray,
+    block_table: jnp.ndarray,  # [B, T]
+    q_positions: jnp.ndarray,  # [B, S]
+    kv_positions: jnp.ndarray,  # [B, T*BS] logical-column key positions
+    scale: float | None = None,
+    k_scale: jnp.ndarray = None,  # [NB, Nkv] — quantized arenas only
+    v_scale: jnp.ndarray = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Partial-softmax attention statistics over the LOCAL arena — the
+    per-shard half of context-parallel attention. Returns the flash
+    recurrence's running triple rather than a normalized output:
+    ``acc [B, S, Nh, D]`` (f32, sum of ``exp(s - m) · v``), ``m [B, S,
+    Nh]`` (f32 row max) and ``l [B, S, Nh]`` (f32 sum of ``exp(s - m)``),
+    exactly the ``(acc, m, l)`` scratch ``_online_update`` carries —
+    ``combine_attn_stats`` reduces shards' triples with the same
+    recurrence, so the combined output equals single-shard attention over
+    the union of windows by construction.
+
+    Two masking differences vs ``cached_attention``: columns are masked
+    by position AND by slot-liveness (``block_table != 0``). Under cp a
+    column another shard owns maps to the local trash block — its
+    position is real and its gathered K is the zero-gate's zeros, so a
+    positional mask alone would hand it weight ``exp(0 · scale - m)``
+    and corrupt ``l``. Masked columns contribute EXACTLY zero (``where``
+    on the probabilities, not just NEG_INF scores): a fully-masked row
+    yields ``(0, NEG_INF, 0)``, which the combine's correction factor
+    wipes instead of counting ``exp(0) = 1`` per dead column."""
+    B, S, Nh, D = q.shape
+    BS = k_arena.shape[1]
+    k, v = gather_block_kv(
+        k_arena, v_arena, block_table, k_scale, v_scale, out_dtype=q.dtype
+    )
+    Nkv = k.shape[2]
+    G = Nh // Nkv
+    if scale is None:
+        scale = D ** -0.5
+    qg = q.reshape(B, S, Nkv, G, D)
+    # same einsum/precision contract as cached_attention: fp32 ACCUMULATION
+    # via preferred_element_type, operands in their storage dtype
+    scores = jnp.einsum(
+        "bskgd,btkd->bkgst", qg, k, preferred_element_type=jnp.float32,
+    ) * scale
+    live = jnp.repeat(block_table != 0, BS, axis=1)  # [B, T*BS]
+    mask = (
+        (kv_positions[:, None, :] <= q_positions[:, :, None])
+        & live[:, None, :]
+    )  # [B, S, W]
+    mask = mask[:, None, None, :, :]  # [B,1,1,S,W]
+    scores = jnp.where(mask, scores, jnp.float32(NEG_INF))
+    m = scores.max(axis=-1)  # [B, Nkv, G, S]
+    p = jnp.where(mask, jnp.exp(scores - m[..., None]), jnp.float32(0.0))
+    l = p.sum(axis=-1)  # [B, Nkv, G, S]
+    # probabilities down-cast to the cache dtype for the PV matmul — the
+    # same precision contract as cached_attention / the Pallas kernel
+    acc = jnp.einsum(
+        "bkgst,btkd->bskgd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    ).reshape(B, S, Nh, D)
+    to_bsn = lambda x: jnp.transpose(x, (0, 3, 1, 2)).reshape(B, S, Nh)
+    return acc, to_bsn(m), to_bsn(l)
+
+
+def combine_attn_stats(
+    acc: jnp.ndarray,  # [B, S, Nh, D] f32 per-shard unnormalized output
+    m: jnp.ndarray,  # [B, S, Nh] f32 per-shard row max
+    l: jnp.ndarray,  # [B, S, Nh] f32 per-shard exp-sum
+    axis_name: str,
+) -> jnp.ndarray:
+    """Cross-shard online-softmax combine: rebase every shard's ``(acc,
+    l)`` onto the global row max and psum — one step of the
+    ``_online_update`` recurrence applied across ``axis_name`` instead of
+    across streamed KV tiles. Exact by the usual flash identity:
+    ``softmax(concat(s_i)) · V = Σ_i exp(m_i - m) · acc_i / Σ_i
+    exp(m_i - m) · l_i``. Rows no shard attends anywhere (parked rows
+    mapped entirely to trash) come out as zeros, not NaN — ``l`` stays 0
+    through the psum and the guard below short-circuits the division.
+    Returns the normalized f32 output ``[B, S, Nh, D]`` (callers cast
+    back to the activation dtype)."""
+    m_all = jax.lax.pmax(m, axis_name)
+    corr = jnp.exp(m - m_all)  # exp(NEG_INF - finite) == 0: dead shards drop
+    l_all = jax.lax.psum(l * corr, axis_name)
+    acc_all = jax.lax.psum(acc * corr[..., None], axis_name)
+    return jnp.where(
+        l_all[..., None] > 0.0,
+        acc_all / jnp.maximum(l_all, 1e-30)[..., None],
+        jnp.float32(0.0),
+    )
+
+
 def _online_update(q, k, v, mask, scale, acc_ref, m_ref, l_ref):
     """One flash-attention recurrence step over a streamed KV tile: score
     the tile, fold it into the (acc, m, l) running softmax scratch. Shared
@@ -766,6 +858,7 @@ def paged_prefill(
     k_scale: jnp.ndarray = None,  # [NB, Nkv] — quantized arenas only
     v_scale: jnp.ndarray = None,
     nlive: jnp.ndarray = None,  # [B] — kernel-path traffic clamp
+    stats: bool = False,  # static: return (acc, m, l) partials (cp serve)
 ) -> jnp.ndarray:
     """Backend dispatch for CHUNKED-PREFILL attention over the arena,
     mirroring ``paged_attention``: the Pallas prefill kernel on TPU for
@@ -774,11 +867,22 @@ def paged_prefill(
     only, ``interpret`` emulates the kernel off-TPU (the CI lane).
     Identical numerics on every path (the XLA gather is the oracle the
     chunked-prefill tests assert against); ``nlive`` only trims kernel
-    KV traffic — the gather path reads the whole window regardless."""
+    KV traffic — the gather path reads the whole window regardless.
+
+    ``stats=True`` (the context-parallel serve path) returns
+    ``attn_stats_xla``'s unnormalized ``(acc, m, l)`` triple instead of a
+    normalized output; stats mode always runs the XLA gather path —
+    a stats-emitting kernel is the ROADMAP's ring-fusion leftover — so
+    ``backend`` only selects the single-shard dispatch."""
     if backend not in BACKENDS:
         raise ValueError(
             f"paged_prefill backend {backend!r}: expected one of "
             f"{BACKENDS}"
+        )
+    if stats:
+        return attn_stats_xla(
+            q, k_arena, v_arena, block_table, q_positions, kv_positions,
+            scale, k_scale=k_scale, v_scale=v_scale,
         )
     if backend == "auto":
         backend = forced_backend() or "auto"
@@ -833,6 +937,7 @@ def paged_attention(
     backend: str = "auto",
     k_scale: jnp.ndarray = None,  # [NB, Nkv] — quantized arenas only
     v_scale: jnp.ndarray = None,
+    stats: bool = False,  # static: return (acc, m, l) partials (cp serve)
 ) -> jnp.ndarray:
     """Backend dispatch: the Pallas kernel on TPU for MXU-aligned shapes,
     the exact XLA gather path otherwise (CPU meshes, ragged head dims,
@@ -842,11 +947,23 @@ def paged_attention(
     Identical numerics either way (interpret-mode tested on CPU). With
     ``k_scale``/``v_scale`` the arena is quantized (int8/fp8): the kernel
     fuses the dequant into its per-block DMA loop, the XLA path
-    dequantizes at the gather — both into the query dtype."""
+    dequantizes at the gather — both into the query dtype.
+
+    ``stats=True`` (the context-parallel serve path) returns
+    ``attn_stats_xla``'s unnormalized ``(acc, m, l)`` triple for the
+    cross-shard ``combine_attn_stats`` reduction; stats mode always runs
+    the XLA gather path (the stats-emitting kernel is the ROADMAP
+    ring-fusion leftover), so ``backend`` only governs the plain
+    single-shard dispatch."""
     if backend not in BACKENDS:
         raise ValueError(
             f"paged_attention backend {backend!r}: expected one of "
             f"{BACKENDS}"
+        )
+    if stats:
+        return attn_stats_xla(
+            q, k_arena, v_arena, block_table, q_positions, kv_positions,
+            scale, k_scale=k_scale, v_scale=v_scale,
         )
     if backend == "auto":
         backend = forced_backend() or "auto"

@@ -20,6 +20,9 @@ from jax.sharding import Mesh
 PIPE_AXIS = "pipe"  # pipeline-chain axis (≙ the reference's device chain)
 DATA_AXIS = "data"  # batch/data-parallel axis (capability the reference lacks)
 SEQ_AXIS = "seq"  # sequence/context-parallel axis (ring attention)
+CP_AXIS = "cp"  # serve-side context-parallel axis: the paged KV arena's
+#   block pool is sharded across it (one sub-arena + block table plane per
+#   shard), decode combines per-shard attention partials across it
 
 
 def _device_grid(shape: tuple[int, ...], devices: Optional[Sequence]):
@@ -53,6 +56,21 @@ def pipeline_mesh(
     """1-D mesh over the pipeline axis; one stage per device
     (BASELINE north star: "one NodeController per TPU chip")."""
     return Mesh(_device_grid((num_stages,), devices), (PIPE_AXIS,))
+
+
+def pipeline_cp_mesh(
+    cp: int, num_stages: int, devices: Optional[Sequence] = None
+) -> Mesh:
+    """2-D mesh for context-parallel serving: ``cp`` copies of the
+    pipeline chain, each owning one shard of the paged KV arena. Like
+    ``pipeline_data_mesh`` the pipe axis is minor so every chain's
+    stage→stage hop stays on neighboring devices; the cp hop (the decode
+    softmax-combine all-reduce and the ring prefill pass) crosses the
+    major axis once per layer."""
+    return Mesh(
+        _device_grid((cp, num_stages), devices),
+        (CP_AXIS, PIPE_AXIS),
+    )
 
 
 def pipeline_data_mesh(

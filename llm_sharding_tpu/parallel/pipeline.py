@@ -77,10 +77,23 @@ class ModelFns(NamedTuple):
     stage_paged: Any = None
 
 
-def model_fns(cfg: ModelConfig, tp_axis: Optional[str] = None) -> ModelFns:
+def model_fns(
+    cfg: ModelConfig,
+    tp_axis: Optional[str] = None,
+    cp_axis: Optional[str] = None,
+) -> ModelFns:
+    """``cp_axis`` threads the serve-side context-parallel combine into
+    the paged stage fn: each shard's ``stage_paged`` sees a per-shard
+    arena/table slice and attention partials reduce across ``cp_axis``
+    (``models/llama.paged_decoder_layer``). Gated to llama upstream
+    (``engine.serve`` validation) — gpt2's paged path never sees it."""
     if cfg.model_type == "llama":
         fwd, fwd_paged = llama.forward_layers, llama.forward_layers_paged
     elif cfg.model_type == "gpt2":
+        if cp_axis is not None:
+            raise NotImplementedError(
+                "context-parallel serving supports the llama family only"
+            )
         fwd, fwd_paged = gpt2.forward_layers, gpt2.forward_layers_paged
     else:
         raise ValueError(f"unsupported model_type: {cfg.model_type!r}")
@@ -91,11 +104,12 @@ def model_fns(cfg: ModelConfig, tp_axis: Optional[str] = None) -> ModelFns:
     def stage_paged(cfg_, layers, h, k_arena, v_arena, tbl, cols, kv_pos,
                     positions, mask, write_valid=True, backend="auto",
                     k_scale=None, v_scale=None, prefill=False, nlive=None):
+        kw = {} if cp_axis is None else {"cp_axis": cp_axis}
         return fwd_paged(
             cfg_, layers, h, k_arena, v_arena, tbl, cols, kv_pos,
             positions, mask, write_valid=write_valid, tp_axis=tp_axis,
             backend=backend, k_scale=k_scale, v_scale=v_scale,
-            prefill=prefill, nlive=nlive,
+            prefill=prefill, nlive=nlive, **kw,
         )
 
     return ModelFns(stage=stage, stage_paged=stage_paged)

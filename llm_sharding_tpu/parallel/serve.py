@@ -49,7 +49,7 @@ from .head import (
     _local_logits, head_specs, key_chain_split, local_view, psum_from,
     seed_chain_init, sp_embed, sp_next_token, sp_sample_rows,
 )
-from .mesh import PIPE_AXIS
+from .mesh import CP_AXIS, PIPE_AXIS
 from .pipeline import (
     model_fns, ring_chain, ring_chain_paged, stage_layer_specs,
 )
@@ -112,36 +112,53 @@ class ServeState(NamedTuple):
 
 
 def _dev(spec: P) -> bool:
-    """True for per-device (pipe-stacked) leaves — the bodies strip/restore
-    their leading stage dim. A prefix match, not equality: with tensor
-    parallelism the KV leaves carry a TENSOR_AXIS entry on the heads dim."""
-    return len(spec) > 0 and spec[0] == PIPE_AXIS
+    """True for per-device leaves — the bodies strip/restore their leading
+    sharded dim (pipe-stacked state, or the cp-stacked block-table planes).
+    A prefix match, not equality: with tensor parallelism the KV leaves
+    carry a TENSOR_AXIS entry on the heads dim."""
+    return len(spec) > 0 and spec[0] in (PIPE_AXIS, CP_AXIS)
 
 
-def _kv_spec(tp: int) -> P:
+def _kv_spec(tp: int, cp: int = 1) -> P:
     """Spec of every serve-side KV array ([S, Lp, rows, C, Nkv, Dh] state
     leaves and the [S, Lp, 1, Spx, Nkv, Dh] prefix handle): tp > 1 megatron-
     shards the heads dim (the stage fn computes only its tensor shard's
-    heads — the caches store exactly those). THE single source of the KV
-    layout; state_specs, make_state and prefix_prefill all read it."""
+    heads — the caches store exactly those). cp > 1 (paged only, tp gated
+    to 1 by the server) shards the arena's BLOCK dim instead: each cp shard
+    owns a contiguous sub-arena of ``kv_blocks`` blocks. THE single source
+    of the KV layout; state_specs, make_state and prefix_prefill all read
+    it."""
+    if cp > 1:
+        return P(PIPE_AXIS, None, CP_AXIS)
     return (
         P(PIPE_AXIS) if tp == 1
         else P(PIPE_AXIS, None, None, None, TENSOR_AXIS)
     )
 
 
-def state_specs(state: ServeState, tp: int = 1) -> ServeState:
+def state_specs(
+    state: ServeState, tp: int = 1, cp: int = 1, quantized: bool = False
+) -> ServeState:
     dev = P(PIPE_AXIS)
     rep = P()
-    kv = _kv_spec(tp)
+    kv = _kv_spec(tp, cp)
     # scale arenas are pipe-sharded only (full Nkv per shard; quantized KV
     # is gated to tp == 1 by the server — heads-sharded scale plumbing is
-    # future work)
+    # future work). Under cp > 1 a QUANTIZED arena's scales follow the
+    # block dim's cp sharding; the bf16 placeholder ([S, 1, 1, 1]) stays
+    # pipe-only (nothing to shard).
+    scale = P(PIPE_AXIS, None, CP_AXIS) if (cp > 1 and quantized) else dev
+    # block tables: replicated host-pushed [M, T] normally; under cp > 1
+    # the host pushes PER-SHARD planes [cp, M, T] of LOCAL block ids (each
+    # shard's plane maps unowned columns to its local trash block 0), so
+    # the leaf is cp-stacked and the bodies strip the leading dim like any
+    # pipe leaf.
+    tbl = P(CP_AXIS) if cp > 1 else rep
     return ServeState(
-        k=kv, v=kv, k_scale=dev, v_scale=dev, kpos=dev, h=dev,
+        k=kv, v=kv, k_scale=scale, v_scale=scale, kpos=dev, h=dev,
         h_valid=dev, pos_slots=dev, write_off=dev, out=rep, lengths=rep,
         done=rep, budget=rep, inject=rep, inject_pending=rep, rng=rep,
-        temp=rep, topk=rep, topp=rep, block_tables=rep, m=rep,
+        temp=rep, topk=rep, topp=rep, block_tables=tbl, m=rep,
     )
 
 
@@ -211,6 +228,7 @@ def make_state(
     tp: int = 1,
     kv_blocks: int = 0,
     kv_block_size: int = 0,
+    cp: int = 1,
 ) -> ServeState:
     """Host-constructed empty state (all slots free / done).
 
@@ -220,7 +238,14 @@ def make_state(
     ..]`` reservations, and every row's logical window is ``W = ceil(C /
     BS) * BS`` columns mapped through ``block_tables`` (all entries start
     at the trash block 0). HBM then scales with the arena size the operator
-    budgets, not rows × capacity — the whole point of paged serving."""
+    budgets, not rows × capacity — the whole point of paged serving.
+
+    With ``cp > 1`` (paged only) ``kv_blocks`` is PER SHARD: the global
+    arena holds ``cp * kv_blocks`` blocks sharded contiguously over the cp
+    axis (global block id ``g`` lives on shard ``g // kv_blocks`` at local
+    id ``g % kv_blocks`` — the identity the host's table projection and
+    ``ShardedBlockAllocator`` both rely on), and ``block_tables`` becomes
+    the cp-stacked per-shard planes ``[cp, M, T]`` of LOCAL ids."""
     S = mesh.shape[PIPE_AXIS]
     Bs = batch_per_slot
     M = S * Bs
@@ -238,7 +263,7 @@ def make_state(
     H = cfg.hidden_size
     dev = NamedSharding(mesh, P(PIPE_AXIS))
     rep = NamedSharding(mesh, P())
-    dev_kv = NamedSharding(mesh, _kv_spec(tp))
+    dev_kv = NamedSharding(mesh, _kv_spec(tp, cp))
 
     single = jax.process_count() == 1
 
@@ -269,7 +294,9 @@ def make_state(
     if paged:
         from ..models.cache import block_pool_shape
 
-        kv_shape = (S, *block_pool_shape(cfg, kv_blocks, kv_block_size, Lp))
+        kv_shape = (
+            S, *block_pool_shape(cfg, cp * kv_blocks, kv_block_size, Lp)
+        )
     else:
         kv_shape = (S, Lp, M, C, cfg.num_key_value_heads, cfg.head_dim_)
     # quantized (int8/fp8) arenas carry per-block-per-head scale arenas;
@@ -277,14 +304,20 @@ def make_state(
     # treatment as dense mode's [M, 1] block-table stub)
     quantized = paged and is_kv_quantized(cache_dtype)
     scale_shape = (
-        (S, Lp, kv_blocks, cfg.num_key_value_heads) if quantized
+        (S, Lp, cp * kv_blocks, cfg.num_key_value_heads) if quantized
         else (S, 1, 1, 1)
     )
+    dev_scale = (
+        NamedSharding(mesh, P(PIPE_AXIS, None, CP_AXIS))
+        if (cp > 1 and quantized) else dev
+    )
+    tbl_shape = (cp, M, T) if cp > 1 else (M, T)
+    tbl_sh = NamedSharding(mesh, P(CP_AXIS)) if cp > 1 else rep
     state = ServeState(
         k=zeros(kv_shape, cache_dtype, dev_kv),
         v=zeros(kv_shape, cache_dtype, dev_kv),
-        k_scale=zeros(scale_shape, jnp.float32, dev),
-        v_scale=zeros(scale_shape, jnp.float32, dev),
+        k_scale=zeros(scale_shape, jnp.float32, dev_scale),
+        v_scale=zeros(scale_shape, jnp.float32, dev_scale),
         kpos=put(np.full((S, M, C), int(POS_SENTINEL), np.int32), dev),
         h=put(np.zeros((S, Bs, 1, H), act_dtype), dev),
         h_valid=put(np.zeros((S,), np.bool_), dev),
@@ -300,7 +333,7 @@ def make_state(
         temp=put(np.zeros((M,), np.float32), rep),
         topk=put(np.zeros((M,), np.int32), rep),
         topp=put(np.ones((M,), np.float32), rep),
-        block_tables=put(np.zeros((M, T), np.int32), rep),
+        block_tables=put(np.zeros(tbl_shape, np.int32), tbl_sh),
         m=put(np.zeros((), np.int32), rep),
     )
     return state
@@ -495,7 +528,7 @@ def cancel_rows_batched(state: ServeState, rows, n_rows: int) -> ServeState:
     jax.jit,
     static_argnames=(
         "cfg", "mesh", "num_stages", "cache_dtype", "filtering", "tp",
-        "block_size", "prefix_in_arena",
+        "block_size", "prefix_in_arena", "cp",
     ),
     donate_argnums=(5,),  # the previous ServeState buffers are dead on
     # return (the server reassigns self.state) — donation halves the
@@ -530,6 +563,12 @@ def serve_admit(
     block_size: int = 0,  # static: paged-KV block size (0 = dense state)
     prefix_in_arena: bool = False,  # static: the prefix blocks ALREADY hold
     #   this KV (radix-hit admission) — skip re-scattering them; see below
+    cp: int = 1,  # static: context-parallel degree — the arena's block dim
+    #   is sharded over CP_AXIS and block_tables is the cp-stacked [cp, M,
+    #   T] per-shard planes. The one-shot prefill itself is cp-REPLICATED
+    #   (dense in-register compute, no arena reads); only the scatter back
+    #   differs per shard, and it lands owned columns in real local blocks
+    #   while unowned columns fall into the shard's local trash block 0.
 ):
     """Prefill ``slot`` with up to Bs new requests while the rest of the
     pipeline state is parked. Returns the updated state.
@@ -598,7 +637,7 @@ def serve_admit(
         sidx = jax.lax.axis_index(PIPE_AXIS)
         st = jax.tree.map(
             lambda spec, leaf: leaf[0] if _dev(spec) else leaf,
-            state_specs(state, tp), state,
+            state_specs(state, tp, cp, quantized), state,
         )
         row0 = slot * Bs
 
@@ -775,11 +814,13 @@ def serve_admit(
         )
         new = jax.tree.map(
             lambda spec, leaf: leaf[None] if _dev(spec) else leaf,
-            state_specs(state, tp), new,
+            state_specs(state, tp, cp, quantized), new,
         )
         return new, tok0
 
-    specs = state_specs(ServeState(*([None] * len(ServeState._fields))), tp)
+    specs = state_specs(
+        ServeState(*([None] * len(ServeState._fields))), tp, cp, quantized
+    )
     out_state, tok0 = shard_map(
         body,
         mesh=mesh,
@@ -808,7 +849,7 @@ def serve_admit(
     jax.jit,
     static_argnames=(
         "cfg", "mesh", "num_stages", "tp", "block_size", "cache_dtype",
-        "attn",
+        "attn", "cp",
     ),
     donate_argnums=(5,),  # see serve_admit
 )
@@ -844,6 +885,12 @@ def serve_prefill_chunk(
     #   CPU/tier-1 fallback), "kernel" (the Pallas chunked-prefill
     #   kernel), "interpret" (the kernel emulated, CI on CPU). Resolved
     #   host-side by runtime/server.py; ignored in dense mode
+    cp: int = 1,  # static: context-parallel degree. Each cp shard writes
+    #   the chunk's fresh KV through ITS table plane (owned columns land in
+    #   real local blocks, the rest in local trash) and computes partial
+    #   attention stats over its local blocks; the layer combines partials
+    #   across CP_AXIS (online-softmax merge) — the RING-PASS form of
+    #   chunked prefill. Forces attn="xla" stats mode inside the op.
 ):
     """One bounded chunk of an admission prefill (r2 weak #4 / next-#4).
 
@@ -877,7 +924,10 @@ def serve_prefill_chunk(
     under concurrent readers, the same argument as ``serve_admit``'s
     ``prefix_in_arena``.
     """
-    fns = model_fns(cfg, tp_axis=TENSOR_AXIS if tp > 1 else None)
+    fns = model_fns(
+        cfg, tp_axis=TENSOR_AXIS if tp > 1 else None,
+        cp_axis=CP_AXIS if cp > 1 else None,
+    )
     Bs, Sc = tokens.shape
     ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
     quantized = is_kv_quantized(state.k.dtype)  # trace-time constant
@@ -892,7 +942,7 @@ def serve_prefill_chunk(
         sidx = jax.lax.axis_index(PIPE_AXIS)
         st = jax.tree.map(
             lambda spec, leaf: leaf[0] if _dev(spec) else leaf,
-            state_specs(state, tp), state,
+            state_specs(state, tp, cp, quantized), state,
         )
         row0 = slot * Bs
         col0 = prefix_off + chunk_off  # absolute cache column of the chunk
@@ -992,10 +1042,12 @@ def serve_prefill_chunk(
         )
         return jax.tree.map(
             lambda spec, leaf: leaf[None] if _dev(spec) else leaf,
-            state_specs(state, tp), new,
+            state_specs(state, tp, cp, quantized), new,
         )
 
-    specs = state_specs(ServeState(*([None] * len(ServeState._fields))), tp)
+    specs = state_specs(
+        ServeState(*([None] * len(ServeState._fields))), tp, cp, quantized
+    )
     return shard_map(
         body,
         mesh=mesh,
@@ -1011,7 +1063,7 @@ def serve_prefill_chunk(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "mesh", "num_stages", "tp"),
+    jax.jit, static_argnames=("cfg", "mesh", "num_stages", "tp", "cp"),
     donate_argnums=(3,),  # see serve_admit
 )
 def serve_admit_finish(
@@ -1031,6 +1083,8 @@ def serve_admit_finish(
     num_stages: int,
     tp: int = 1,
     key_override: Any = None,  # ([Bs, 2] uint32, [Bs] bool) — see below
+    cp: int = 1,  # static: context-parallel degree (spec plumbing only —
+    #   this program touches no KV; see serve_prefill_chunk)
 ):
     """Arm a chunk-prefilled slot: park each row's final prompt token in the
     injection path at position ``prompt_len - 1``. The slot's first
@@ -1047,6 +1101,7 @@ def serve_admit_finish(
     the next commit's split then yields draw ``t+1``, exactly where the
     source replica's chain stood."""
     Bs = last_tok.shape[0]
+    quantized = is_kv_quantized(state.k.dtype)  # trace-time constant
 
     def body(head_params, state, last_tok, prompt_len, row_valid, slot,
              max_new, seeds, temperature, top_k, top_p, key_override):
@@ -1054,7 +1109,7 @@ def serve_admit_finish(
         sidx = jax.lax.axis_index(PIPE_AXIS)
         st = jax.tree.map(
             lambda spec, leaf: leaf[0] if _dev(spec) else leaf,
-            state_specs(state, tp), state,
+            state_specs(state, tp, cp, quantized), state,
         )
         row0 = slot * Bs
 
@@ -1107,10 +1162,12 @@ def serve_admit_finish(
         )
         return jax.tree.map(
             lambda spec, leaf: leaf[None] if _dev(spec) else leaf,
-            state_specs(state, tp), new,
+            state_specs(state, tp, cp, quantized), new,
         )
 
-    specs = state_specs(ServeState(*([None] * len(ServeState._fields))), tp)
+    specs = state_specs(
+        ServeState(*([None] * len(ServeState._fields))), tp, cp, quantized
+    )
     return shard_map(
         body,
         mesh=mesh,
@@ -1129,7 +1186,7 @@ def serve_admit_finish(
     jax.jit,
     static_argnames=(
         "cfg", "mesh", "num_stages", "n_micro", "sampling", "filtering", "tp",
-        "block_size", "attn",
+        "block_size", "attn", "cp",
     ),
     donate_argnums=(5,),  # see serve_admit
 )
@@ -1151,6 +1208,11 @@ def serve_chunk(
     #   fallback), "kernel" (Pallas: streams only each row's mapped
     #   blocks) or "interpret" (the kernel emulated, CI on CPU). Resolved
     #   host-side by runtime/server.py; ignored in dense mode
+    cp: int = 1,  # static: context-parallel degree — each shard attends
+    #   its LOCAL arena blocks (unowned columns are trash-mapped and
+    #   zero-gated) emitting online-softmax partials (acc, m, l) that the
+    #   layer combines across CP_AXIS; fresh decode KV scatters through
+    #   each shard's own table plane so exactly the owner keeps it.
 ):
     """Run ``n_micro`` interleaved microsteps on the live state. Returns
     ``(state, log)`` where ``log`` is ``[n_micro, Bs]`` int32 — the token
@@ -1180,7 +1242,10 @@ def serve_chunk(
     host block-table push (``_flush_tables``) needs only the PLANNED
     mirror deltas, never fetched tokens, so it keeps its place before
     each dispatch."""
-    fns = model_fns(cfg, tp_axis=TENSOR_AXIS if tp > 1 else None)
+    fns = model_fns(
+        cfg, tp_axis=TENSOR_AXIS if tp > 1 else None,
+        cp_axis=CP_AXIS if cp > 1 else None,
+    )
     ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
     last = num_stages - 1
     M = state.out.shape[0]
@@ -1194,7 +1259,7 @@ def serve_chunk(
         sidx = jax.lax.axis_index(PIPE_AXIS)
         st = jax.tree.map(
             lambda spec, leaf: leaf[0] if _dev(spec) else leaf,
-            state_specs(state, tp), state,
+            state_specs(state, tp, cp, quantized), state,
         )
 
         def micro(_, s: ServeState) -> ServeState:
@@ -1390,11 +1455,13 @@ def serve_chunk(
         st, log = jax.lax.fori_loop(0, n_micro, micro_carry, (st, log0))
         st = jax.tree.map(
             lambda spec, leaf: leaf[None] if _dev(spec) else leaf,
-            state_specs(state, tp), st,
+            state_specs(state, tp, cp, quantized), st,
         )
         return st, log
 
-    specs = state_specs(ServeState(*([None] * len(ServeState._fields))), tp)
+    specs = state_specs(
+        ServeState(*([None] * len(ServeState._fields))), tp, cp, quantized
+    )
     return shard_map(
         body,
         mesh=mesh,
@@ -1411,7 +1478,7 @@ def serve_chunk(
     jax.jit,
     static_argnames=(
         "cfg", "mesh", "num_stages", "K", "sampling", "filtering", "tp",
-        "block_size", "attn",
+        "block_size", "attn", "cp",
     ),
     donate_argnums=(5,),  # see serve_admit
 )
@@ -1437,6 +1504,9 @@ def serve_verify(
     tp: int = 1,
     block_size: int = 0,  # static: paged-KV block size (0 = dense state)
     attn: str = "xla",  # static: paged attention backend (see serve_chunk)
+    cp: int = 1,  # static: context-parallel degree — cp > 1 is rejected
+    #   (speculation is gated off under cp by the server; the guard makes
+    #   the program's contract explicit if that gate ever regresses)
 ):
     """Speculative verify for one slot: ONE parked-pipeline ring traversal
     over the K+1 draft positions per row — a tiny prefill (the ``serve_admit``
@@ -1477,6 +1547,14 @@ def serve_verify(
     # runtime package at module load)
     from ..runtime.spec import _leading_true_count, cap_commits, rejection_commit
 
+    if cp > 1:
+        raise NotImplementedError(
+            "serve_verify does not support context-parallel serving (cp > "
+            "1): speculative decode commits a VARIABLE number of tokens per "
+            "row, and the cross-shard combine for its K+1-position "
+            "traversal is not wired — the server gates speculate off under "
+            "cp (ROADMAP: cp-aware speculation)"
+        )
     fns = model_fns(cfg, tp_axis=TENSOR_AXIS if tp > 1 else None)
     Bs = draft.shape[0]
     ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]

@@ -155,6 +155,14 @@ class BlockAllocator:
         self._ref[taken] = 1
         return taken
 
+    def alloc_at(self, start_col: int, n: int) -> list[int]:
+        """``alloc`` with a placement HINT: ``start_col`` is the first
+        logical column index (in blocks) the allocation will map. The
+        single-pool allocator has no placement to prefer — this exists so
+        callers can be shard-agnostic (``ShardedBlockAllocator`` overrides
+        it to stripe ownership across context-parallel shards)."""
+        return self.alloc(n)
+
     def share(self, blocks) -> None:
         """Add a reference to each of ``blocks`` (prefix sharing: a row maps
         an already-allocated block read-only into its table)."""
@@ -227,3 +235,187 @@ class BlockAllocator:
             )
         if self._ref[TRASH_BLOCK] != 1:
             raise AssertionError("trash block refcount must stay pinned at 1")
+
+
+class ShardedBlockAllocator(BlockAllocator):
+    """Per-shard free lists over a GLOBALLY indexed block id space — the
+    host half of context-parallel paged serving (``serve(cp=N)``).
+
+    Global block id ``gid = shard · blocks_per_shard + local``: the device
+    arena is ``[S, Lp, cp · NB, ...]`` sharded contiguously on its block
+    axis, so this layout makes gid arithmetic (``gid // NB`` = owning
+    shard, ``gid % NB`` = local block) line up with the device placement —
+    the server's host table mirror keeps gids and
+    ``_push_tables`` projects them to per-shard LOCAL tables. EVERY
+    shard's local block 0 (gid ``s · NB``) is that shard's trash sink,
+    pinned exactly like the base allocator's global block 0: a column one
+    shard owns maps to trash on every other shard, so unowned writes land
+    in a block nobody attends.
+
+    ``alloc_at`` stripes ownership round-robin by logical column with a
+    greedy most-free fallback, so TOTAL free blocks (``num_free``) remains
+    a correct admission bound: as long as ``n <= num_free``, n picks each
+    find some shard with a free block — allocation never fails on a
+    per-shard bottleneck. The flat base free list is kept in sync as a
+    view so every inherited accounting property (``num_free``,
+    ``in_use``, the KV gauges' reads) stays truthful."""
+
+    def __init__(self, shards: int, blocks_per_shard: int, block_size: int):
+        if shards < 1:
+            raise ValueError(f"shards must be >= 1, got {shards}")
+        if blocks_per_shard < 2:
+            raise ValueError(
+                f"blocks_per_shard must be >= 2 (each shard's local block "
+                f"0 is its reserved trash sink), got {blocks_per_shard}"
+            )
+        super().__init__(shards * blocks_per_shard, block_size)
+        self.shards = shards
+        self.blocks_per_shard = blocks_per_shard
+        for s in range(1, shards):
+            self._ref[s * blocks_per_shard] = 1  # pin per-shard trash
+        self._shard_free: list[list[int]] = [
+            list(range(
+                (s + 1) * blocks_per_shard - 1, s * blocks_per_shard, -1
+            ))
+            for s in range(shards)
+        ]
+        self._sync_free()
+
+    def _sync_free(self) -> None:
+        # the base's flat list is a derived VIEW (num_free/in_use/gauges
+        # read it); the per-shard lists are the source of truth
+        self._free = [b for fl in self._shard_free for b in fl]
+
+    def owner(self, gid: int) -> int:
+        """Owning shard of a global block id."""
+        return int(gid) // self.blocks_per_shard
+
+    @property
+    def capacity_blocks(self) -> int:
+        """Allocatable blocks: each shard donates its local block 0."""
+        return self.shards * (self.blocks_per_shard - 1)
+
+    def _take(self, shard: int) -> int:
+        b = self._shard_free[shard].pop()
+        self._ref[b] = 1
+        return b
+
+    def alloc(self, n: int) -> list[int]:
+        """Positionless ``n``-block grab (radix restore, embedding rows):
+        balance by always taking from the most-free shard."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > self.num_free:
+            raise BlockExhausted(
+                f"need {n} KV blocks, {self.num_free} free "
+                f"(of {self.capacity_blocks} across {self.shards} shards)"
+            )
+        taken = []
+        for _ in range(n):
+            s = max(range(self.shards), key=lambda i: len(self._shard_free[i]))
+            taken.append(self._take(s))
+        self._sync_free()
+        return taken
+
+    def alloc_at(self, start_col: int, n: int) -> list[int]:
+        """Column-striped allocation: block ``j`` of the run (logical
+        column ``start_col + j``) prefers shard ``(start_col + j) % cp``
+        so one row's KV — and with it each decode step's fresh-token
+        write and every prefill chunk's columns — spreads across shards;
+        falls back to the most-free shard when the preferred list is dry
+        (which is what makes total-free a sufficient admission bound)."""
+        if n < 0:
+            raise ValueError(f"alloc_at({start_col}, {n})")
+        if n > self.num_free:
+            raise BlockExhausted(
+                f"need {n} KV blocks, {self.num_free} free "
+                f"(of {self.capacity_blocks} across {self.shards} shards)"
+            )
+        taken = []
+        for j in range(n):
+            s = (int(start_col) + j) % self.shards
+            if not self._shard_free[s]:
+                s = max(
+                    range(self.shards),
+                    key=lambda i: len(self._shard_free[i]),
+                )
+            taken.append(self._take(s))
+        self._sync_free()
+        return taken
+
+    def share(self, blocks) -> None:
+        for b in blocks:
+            if int(b) % self.blocks_per_shard == 0:
+                raise ValueError(
+                    f"share of reserved trash block {int(b)}"
+                )
+        super().share(blocks)
+
+    def mark_cached(self, blocks) -> None:
+        for b in blocks:
+            if int(b) % self.blocks_per_shard == 0:
+                raise ValueError(
+                    f"mark_cached of reserved trash block {int(b)}"
+                )
+        super().mark_cached(blocks)
+
+    def free(self, blocks) -> None:
+        for b in blocks:
+            b = int(b)
+            if b % self.blocks_per_shard == 0:
+                raise ValueError("free of a reserved trash block")
+            if self._ref[b] < 1:
+                raise ValueError(f"double free of block {b}")
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                self._shard_free[b // self.blocks_per_shard].append(b)
+        self._sync_free()
+
+    def restore(self, private_rows, shared_rows) -> None:
+        super().restore(private_rows, shared_rows)
+        self._shard_free = [
+            sorted(
+                (b for b in self._free
+                 if b // self.blocks_per_shard == s),
+                reverse=True,
+            )
+            for s in range(self.shards)
+        ]
+        self._sync_free()
+
+    def check(self) -> None:
+        NB = self.blocks_per_shard
+        flat = [b for fl in self._shard_free for b in fl]
+        if sorted(flat) != sorted(self._free):
+            raise AssertionError(
+                "per-shard free lists drifted from the flat view"
+            )
+        if len(set(flat)) != len(flat):
+            raise AssertionError(f"free list has duplicates: {flat}")
+        for s in range(self.shards):
+            if self._ref[s * NB] != 1:
+                raise AssertionError(
+                    f"shard {s} trash refcount must stay pinned at 1"
+                )
+            if self._cached[s * NB]:
+                raise AssertionError(f"shard {s} trash block cache-marked")
+            for b in self._shard_free[s]:
+                if b // NB != s or b % NB == 0:
+                    raise AssertionError(
+                        f"free-list entry {b} misfiled under shard {s}"
+                    )
+                if self._ref[b] != 0:
+                    raise AssertionError(
+                        f"free block {b} has refcount {self._ref[b]}"
+                    )
+                if self._cached[b]:
+                    raise AssertionError(f"free block {b} still cache-marked")
+        held = [
+            b for b in range(self.num_blocks)
+            if b % NB != 0 and self._ref[b] > 0
+        ]
+        if len(held) + len(flat) != self.capacity_blocks:
+            raise AssertionError(
+                f"{len(held)} held + {len(flat)} free != "
+                f"{self.capacity_blocks} blocks"
+            )

@@ -515,6 +515,7 @@ class PipelineEngine:
         prefix_cache: str = "off",
         host_pool_blocks: int = 0,
         gauge_sweep_every_s: float = 0.0,
+        cp: int = 1,
     ):
         """Build a continuous-batching server over this engine's sharded
         arrays (≙ the reference's persistent ``run_worker_loop`` daemon,
@@ -569,8 +570,26 @@ class PipelineEngine:
         ``gauge_sweep_every_s=`` paces the per-step load/KV/attn gauge
         sweep (0, the default, sweeps every step — the historical
         behavior); the step profiler (``server.stepline``) makes the
-        sweep's per-step cost visible as its ``gauge_sweep`` phase."""
+        sweep's per-step cost visible as its ``gauge_sweep`` phase.
+
+        ``cp=N`` (paged only) turns on CONTEXT-PARALLEL serving: the server
+        builds a ``(cp, pipe)`` mesh over ``N × num_stages`` devices and
+        shards the paged arena's block pool over the cp axis — each shard
+        owns ``kv_blocks`` blocks, so the admissible context grows ~N× at
+        equal per-chip HBM. Prefill lands each chunk's KV on the owning
+        shard only; decode combines per-shard attention partials with an
+        online-softmax merge, so greedy output stays token-identical to
+        ``cp=1``. Requires ``tensor_parallel == 1``, the llama family, no
+        speculation, and (with ``prefix_cache``) ``prefill_chunk`` set —
+        see ``PipelineServer`` for the exact gates. ``cp=1`` (default)
+        compiles the exact pre-existing programs."""
         self._validate_serve()
+        if cp > 1 and self.tensor_parallel > 1:
+            raise NotImplementedError(
+                "serve×cp×tp: the cp arena sharding and megatron heads "
+                "sharding both claim the KV leaves' trailing dims — pick "
+                "one (cp for long context, tp for big models)"
+            )
         from .server import PipelineServer
 
         return PipelineServer(
@@ -601,6 +620,7 @@ class PipelineEngine:
             prefix_cache=prefix_cache,
             host_pool_blocks=host_pool_blocks,
             gauge_sweep_every_s=gauge_sweep_every_s,
+            cp=cp,
         )
 
     def _shared_server(self, prompt_len: int, max_new: int):

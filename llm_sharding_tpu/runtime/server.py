@@ -235,6 +235,19 @@ _M_FETCH_FAIL = REGISTRY.counter(
     "Prefetched device-to-host reads that raised (chunk logs, admit tokens)",
 )
 
+# -- context-parallel serving telemetry -------------------------------------
+CP_SHARDS = REGISTRY.gauge(
+    "server_cp_shards",
+    "Context-parallel degree of the live server (1 = arena unsharded)",
+)
+CP_COMBINE_SECONDS = REGISTRY.histogram(
+    "server_cp_combine_seconds",
+    "Host-observed wall time of each cp > 1 decode dispatch (trace + "
+    "enqueue of the serve_chunk program containing the cross-shard "
+    "softmax combine; device execution is async — compare against cp=1 "
+    "for the combine's dispatch-side overhead)",
+)
+
 # -- resilience telemetry ---------------------------------------------------
 _M_REJECTED = REGISTRY.counter(
     "server_rejected_total",
@@ -904,11 +917,15 @@ class PipelineServer:
         prefix_cache: str = "off",
         host_pool_blocks: int = 0,
         gauge_sweep_every_s: float = 0.0,
+        cp: int = 1,
     ):
         self.engine = engine
         self.cfg = engine.cfg
         self.mesh = engine.mesh
         self.num_stages = self.mesh.shape[PIPE_AXIS]
+        if cp < 1:
+            raise ValueError(f"cp must be >= 1, got {cp}")
+        self.cp = int(cp)
         # tensor-parallel degree: the serve programs run megatron-sharded
         # stage fns and keep the KV state heads-sharded over TENSOR_AXIS
         self.tp = int(getattr(engine, "tensor_parallel", 1))
@@ -1168,6 +1185,77 @@ class PipelineServer:
         # a position rewind, never a copy of live state). Budget validation
         # everywhere uses the USABLE self.capacity.
         self._spec_cols = self.speculate + 1 if self.speculate else 0
+        # -- context-parallel serving (cp > 1): shard the paged arena ------
+        # The server (not the engine) owns the cp mesh: the engine's 1-D
+        # pipe mesh and placement machinery stay untouched, and cp=1
+        # compiles the exact pre-existing programs against the engine's
+        # live arrays (rollback = flag flip). cp > 1 builds a (cp, pipe)
+        # mesh over cp × num_stages devices and RE-PLACES the stage/head
+        # arrays onto it once, replicated over the cp axis — each array
+        # keeps its existing per-leaf partition spec. The paged arena's
+        # block dim then shards over cp (each shard owns ``kv_blocks``
+        # blocks + its own block-table plane), which is what buys ~cp× the
+        # admissible context at equal per-chip HBM.
+        if self.cp > 1:
+            if not self.paged:
+                raise ValueError(
+                    "cp > 1 needs paged KV serving (set kv_block_size/"
+                    "kv_blocks): context-parallel serving shards the block "
+                    "arena — dense per-row reservations have no block dim "
+                    "to shard"
+                )
+            if self.tp > 1:
+                raise NotImplementedError(
+                    "cp × tp serving: the cp arena sharding and megatron "
+                    "heads sharding both claim the KV leaves' trailing "
+                    "dims — pick one"
+                )
+            if self.cfg.model_type != "llama":
+                raise NotImplementedError(
+                    "context-parallel serving supports the llama family "
+                    "only (the cross-shard softmax combine is threaded "
+                    "through the llama paged layer)"
+                )
+            if self.speculate:
+                raise NotImplementedError(
+                    "cp > 1 with speculate: serve_verify's variable-length "
+                    "commits have no cross-shard combine yet — serve "
+                    "speculative on cp=1, or long-context on cp without "
+                    "speculation (ROADMAP: cp-aware speculation)"
+                )
+            if self.prefix_cache == "host":
+                raise NotImplementedError(
+                    "cp > 1 with prefix_cache='host': the host tier's "
+                    "block save/restore round-trip is not cp-aware yet — "
+                    "use prefix_cache='hbm' (the radix tree itself is "
+                    "cp-safe: blocks stay shard-resident on hits)"
+                )
+            if self.prefix_cache != "off" and self.prefill_chunk is None:
+                raise ValueError(
+                    "cp > 1 with prefix_cache needs prefill_chunk: a radix "
+                    "hit's resident prefix spans multiple shards, so its "
+                    "suffix must prefill arena-native (chunked) — the "
+                    "one-shot gather path cannot assemble a cross-shard "
+                    "window"
+                )
+            if jax.process_count() > 1:
+                raise NotImplementedError(
+                    "cp > 1 on a multi-controller mesh: the per-shard "
+                    "block-table push is single-controller for now"
+                )
+            from ..parallel.mesh import pipeline_cp_mesh
+
+            self.mesh = pipeline_cp_mesh(self.cp, self.num_stages)
+            place = lambda tree: jax.tree.map(
+                lambda a: jax.device_put(
+                    a, jax.sharding.NamedSharding(self.mesh, a.sharding.spec)
+                ),
+                tree,
+            )
+            self._cp_stage_layers = place(engine.stage_layers)
+            self._cp_layer_masks = place(engine.layer_masks)
+            self._cp_head_params = place(engine.head_params)
+        CP_SHARDS.set(float(self.cp))
         self.state = serve_ops.make_state(
             self.cfg,
             self.mesh,
@@ -1182,14 +1270,24 @@ class PipelineServer:
             tp=self.tp,
             kv_blocks=self.kv_blocks or 0,
             kv_block_size=self.kv_block_size or 0,
+            cp=self.cp,
         )
 
         M = self.num_stages * batch_per_slot
         if self.paged:
-            from .blocks import BlockAllocator
+            from .blocks import BlockAllocator, ShardedBlockAllocator
 
-            self._alloc: Optional[BlockAllocator] = BlockAllocator(
-                self.kv_blocks, self.kv_block_size
+            # cp > 1: the allocator hands out GLOBAL block ids over the
+            # cp-sharded arena (owner = gid // kv_blocks), balances rows
+            # across shards and pins every shard's local block 0 as that
+            # shard's trash sink; the host mirror keeps global ids and
+            # projects per-shard LOCAL planes at push time (_push_tables)
+            self._alloc: Optional[BlockAllocator] = (
+                ShardedBlockAllocator(
+                    self.cp, self.kv_blocks, self.kv_block_size
+                )
+                if self.cp > 1
+                else BlockAllocator(self.kv_blocks, self.kv_block_size)
             )
             # device bytes of the pooled arena (codes + scale arenas),
             # published as server_arena_bytes{dtype=} by the gauge sweep —
@@ -1205,7 +1303,7 @@ class PipelineServer:
             # _push_tables ships it whole — [M, T] int32 is a few hundred
             # bytes, far below one chunk log
             self._tables = np.zeros(
-                (M, int(self.state.block_tables.shape[1])), np.int32
+                (M, int(self.state.block_tables.shape[-1])), np.int32
             )
             # per-row ownership: private blocks (refcount 1, freed with the
             # row) and shared prefix blocks (one reference per mapping row)
@@ -1312,6 +1410,30 @@ class PipelineServer:
         _LIVE_SERVERS.add(self)  # load gauges sum over live servers
         _update_health_gauge()  # one-hot shows SERVING from birth, not
         # only after the first health transition
+
+    # -- stage/head arrays the serve programs dispatch against -------------
+    # cp=1 reads the engine's LIVE attributes at every dispatch (hot
+    # placement swap keeps working mid-serve — the historical behavior);
+    # cp>1 reads the one-time cp-mesh copies placed in __init__ (a
+    # repartition invalidates the server, same as any placement change).
+    @property
+    def _stage_layers(self):
+        return (
+            self._cp_stage_layers if self.cp > 1
+            else self.engine.stage_layers
+        )
+
+    @property
+    def _layer_masks(self):
+        return (
+            self._cp_layer_masks if self.cp > 1 else self.engine.layer_masks
+        )
+
+    @property
+    def _head_params(self):
+        return (
+            self._cp_head_params if self.cp > 1 else self.engine.head_params
+        )
 
     def _resolve_attn_impl(self, requested: str) -> str:
         """Resolve the ``paged_attn`` request to the implementation the
@@ -1473,6 +1595,15 @@ class PipelineServer:
         prefixes of similar length share one compiled shape; positions for
         suffix requests resume at the REAL length ``n``, so generation is
         token-exact vs prefilling ``prefix + suffix`` whole."""
+        if self.cp > 1:
+            raise NotImplementedError(
+                "prefill_prefix does not support context-parallel serving "
+                "(cp > 1): an explicit PrefixHandle seeds whole-prefix KV "
+                "into admission, which would need per-shard window gathers "
+                "across the cp-sharded arena. Use prefix_cache='hbm' (the "
+                "radix tree admits hits through the cp-aware chunked path) "
+                "or serve with cp=1."
+            )
         prefix = np.asarray(prefix_ids, np.int32).reshape(-1)
         n = int(prefix.shape[0])
         if n < 1:
@@ -1498,9 +1629,9 @@ class PipelineServer:
         kv = serve_ops.prefix_prefill(
             self.cfg,
             self.mesh,
-            self.engine.stage_layers,
-            self.engine.layer_masks,
-            self.engine.head_params,
+            self._stage_layers,
+            self._layer_masks,
+            self._head_params,
             jnp.asarray(buf),
             jnp.asarray(n, jnp.int32),
             self.num_stages,
@@ -1550,6 +1681,14 @@ class PipelineServer:
         with self._mutex:
             if self._closed:
                 raise ServerClosed("cannot snapshot a closed server")
+            if self.cp > 1:
+                raise NotImplementedError(
+                    "snapshot does not support context-parallel serving "
+                    "(cp > 1): serve_kwargs do not yet carry the cp axis, "
+                    "so a restored server would silently rebuild the arena "
+                    "unsharded. Drain and re-serve, or snapshot a cp=1 "
+                    "server."
+                )
             if self._admitting_rows:
                 raise RuntimeError(
                     "snapshot mid-chunked-admission is not supported — "
@@ -2325,7 +2464,8 @@ class PipelineServer:
             "serve_chunk",
             (self.num_stages, self.batch_per_slot, self.capacity,
              cycles, self._sampling, self._filtering, self.tp,
-             self.kv_block_size, attn, self.kv_dtype),
+             self.kv_block_size, attn, self.kv_dtype)
+            + ((self.cp,) if self.cp > 1 else ()),
         )
 
         def do_chunk():
@@ -2333,9 +2473,9 @@ class PipelineServer:
             return serve_ops.serve_chunk(
                 self.cfg,
                 self.mesh,
-                self.engine.stage_layers,
-                self.engine.layer_masks,
-                self.engine.head_params,
+                self._stage_layers,
+                self._layer_masks,
+                self._head_params,
                 self.state,
                 self.num_stages,
                 cycles,
@@ -2344,9 +2484,11 @@ class PipelineServer:
                 tp=self.tp,
                 block_size=self.kv_block_size or 0,
                 attn=attn,
+                cp=self.cp,
             )
 
         self._flush_tables()
+        t_dispatch = time.perf_counter()
         try:
             self.state, log = self._retry(
                 "chunk_dispatch", do_chunk, real_ok=False
@@ -2355,6 +2497,8 @@ class PipelineServer:
             self.stepline.pop()
             self._contain_dispatch_failure("chunk_dispatch", e)
             return
+        if self.cp > 1:
+            CP_COMBINE_SECONDS.observe(time.perf_counter() - t_dispatch)
         self._pending.append(
             ("chunk",
              self._prefetcher.fetch(log, tag=f"chunk m0={self._m}"),
@@ -2782,7 +2926,11 @@ class PipelineServer:
         need = self._blocks_needed(bucket, max_new, spx, chunked)
         if self._radix is not None and need > self._alloc.num_free:
             self._radix.ensure_free(need)
-        priv = self._alloc.alloc(need)
+        # alloc_at: placement hint for the cp-sharded allocator — private
+        # blocks round-robin across shards starting at the row's first
+        # private column, so long contexts stripe evenly and total-free
+        # stays a correct admission bound (no-op on the base allocator)
+        priv = self._alloc.alloc_at(n_pfx, need)
         self._row_blocks[row] = priv
         tbl = self._tables[row]
         tbl[:] = 0
@@ -2838,8 +2986,8 @@ class PipelineServer:
             # length is the pinned ref's)
             spx_n = rref.n if rref is not None else 0
             chunked = (
-                self.prefill_chunk is not None and plen > spx_n
-                and self._chunked(self._bucket(plen - spx_n))
+                plen > spx_n
+                and self._use_chunked(self._bucket(plen - spx_n), spx_n)
             )
             nb = (plen - (1 if chunked else 0)) // bs
             cand = [int(b) for b in self._tables[row][:nb]]
@@ -2862,12 +3010,26 @@ class PipelineServer:
     def _push_tables(self) -> None:
         """Ship the host block-table mirror to the device state (replicated
         leaf — no program dispatch, just a small transfer; the next
-        dispatched program closes over the new tables)."""
+        dispatched program closes over the new tables).
+
+        cp > 1: the host mirror keeps GLOBAL block ids; the push projects
+        it into the cp-stacked per-shard planes ``[cp, M, T]`` of LOCAL
+        ids the device state carries — shard ``s`` keeps ``g % kv_blocks``
+        where it owns ``g`` (``g // kv_blocks == s``) and maps every other
+        column to its local trash block 0, which is how a single logical
+        write lands on exactly the owning shard with no device-side
+        ownership arithmetic."""
         self.stepline.push("table_push")
         self._tables_dirty = False
+        tables = self._tables
+        if self.cp > 1:
+            nb = self.kv_blocks
+            g = tables[None]  # [1, M, T] global ids
+            sh = np.arange(self.cp, dtype=np.int32)[:, None, None]
+            tables = np.where(g // nb == sh, g % nb, 0).astype(np.int32)
         self.state = self.state._replace(
             block_tables=jax.device_put(
-                self._tables, self.state.block_tables.sharding
+                tables, self.state.block_tables.sharding
             )
         )
         self.stepline.pop()
@@ -2888,6 +3050,14 @@ class PipelineServer:
         dispatch — which is what lets the disagg hand-off sidecar pull
         the device→host copy off the router's step thread without
         freezing this server's pump for the copy's duration."""
+        if self.cp > 1:
+            raise NotImplementedError(
+                "arena block reads do not support context-parallel serving "
+                "(cp > 1): gathering by GLOBAL block id across the "
+                "cp-sharded arena needs per-shard local-id translation "
+                "(cp-aware hand-off streaming — see ROADMAP). The host "
+                "radix tier and disagg hand-off are gated off under cp."
+            )
         idx = jnp.asarray(np.asarray(list(blocks), np.int32))
         out = [
             jnp.take(self.state.k, idx, axis=2),
@@ -2919,6 +3089,13 @@ class PipelineServer:
         doubles). Dispatch order makes it safe: the write precedes any
         program that could attend the restored blocks. Quantized arenas
         restore the scale components alongside the codes, byte-exact."""
+        if self.cp > 1:
+            raise NotImplementedError(
+                "arena block writes do not support context-parallel "
+                "serving (cp > 1): scattering by GLOBAL block id into the "
+                "cp-sharded arena needs per-shard local-id translation "
+                "(cp-aware hand-off streaming — see ROADMAP)."
+            )
         idx = jnp.asarray(np.asarray(list(blocks), np.int32))
         if self.kv_quantized:
             ks_host, vs_host = scales
@@ -3086,6 +3263,14 @@ class PipelineServer:
         verify step, not per token, so the recomputed chain is a fresh
         deterministic continuation rather than the unfaulted run's exact
         draws (greedy spec rows stay token-identical either way)."""
+        if self.cp > 1:
+            raise NotImplementedError(
+                "extract does not support context-parallel serving "
+                "(cp > 1): migrating a request off a cp-sharded server "
+                "needs cp-aware hand-off streaming (see ROADMAP) — the "
+                "adopter would re-prefill against a differently-sharded "
+                "arena."
+            )
         with self._mutex:
             if settle is None:
                 settle = (
@@ -3193,6 +3378,12 @@ class PipelineServer:
         migrated requests are the oldest work in the system. Deliberately
         NOT gated on ``max_queue``: migration moves existing load, it does
         not add any."""
+        if self.cp > 1:
+            raise NotImplementedError(
+                "adopt does not support context-parallel serving (cp > 1): "
+                "a cp-sharded server cannot yet receive migrated requests "
+                "(cp-aware hand-off streaming — see ROADMAP)."
+            )
         with self._mutex:
             if self._closed:
                 _M_REJECTED.labels(reason="closed").inc()
@@ -3627,6 +3818,24 @@ class PipelineServer:
     def _chunked(self, bucket: int) -> bool:
         return self.prefill_chunk is not None and bucket > self.prefill_chunk
 
+    def _use_chunked(self, bucket: int, spx_n: int = 0) -> bool:
+        """THE admit-path choice (one-shot serve_admit vs chunked
+        serve_prefill_chunk) for a ``bucket``-sized suffix past a
+        ``spx_n``-token radix match — the single source the three
+        decision sites (admission planning, the dispatch closure, the
+        release-time insert accounting) all read, so they cannot drift.
+
+        cp > 1 FORCES a radix hit down the chunked path regardless of
+        suffix size: the matched blocks are resident on their owning
+        shards, and only the arena-native chunk prefill can attend
+        cross-shard KV (stats + combine); the one-shot path's
+        ``gather_prefix_kv`` indexes the local arena per shard and cannot
+        assemble a cross-shard prefix operand. (__init__ validated that
+        cp > 1 + prefix_cache implies prefill_chunk is set.)"""
+        if self.cp > 1 and spx_n > 0:
+            return True
+        return self._chunked(bucket)
+
     def _any_active(self, exclude: frozenset = frozenset()) -> bool:
         return any(
             r is not None and not r.done and i not in exclude
@@ -3706,7 +3915,8 @@ class PipelineServer:
             # offset spx_n with the matched KV already resident
             bucket = self._bucket(head.prompt_len - spx_n)
             chunked = (
-                not is_emb and pfx is None and self._chunked(bucket)
+                not is_emb and pfx is None
+                and self._use_chunked(bucket, spx_n)
             )
             spx = pfx.spx if pfx is not None else spx_n
 
@@ -3857,7 +4067,7 @@ class PipelineServer:
                 carried = bool(rng_mask.any())
                 if (
                     not is_emb and pfx is None
-                    and self._chunked(bucket)
+                    and self._use_chunked(bucket, spx_n)
                 ):
                     # chunked admission — cold (prefix_off 0) or from a
                     # radix hit's offset, with the matched blocks already
@@ -3910,14 +4120,15 @@ class PipelineServer:
                     (self.num_stages, Bs, self.capacity, bucket, is_emb,
                      spx_key, self._filtering,
                      self.tp, self.kv_block_size, carried, self.kv_dtype,
-                     in_arena, self.engine.cache_dtype),
+                     in_arena, self.engine.cache_dtype)
+                    + ((self.cp,) if self.cp > 1 else ()),
                 )
                 self.state, tok0 = serve_ops.serve_admit(
                     self.cfg,
                     self.mesh,
-                    self.engine.stage_layers,
-                    self.engine.layer_masks,
-                    self.engine.head_params,
+                    self._stage_layers,
+                    self._layer_masks,
+                    self._head_params,
                     self.state,
                     jnp.asarray(prompts),
                     jnp.asarray(plen),
@@ -3945,6 +4156,7 @@ class PipelineServer:
                     tp=self.tp,
                     block_size=self.kv_block_size or 0,
                     prefix_in_arena=in_arena,
+                    cp=self.cp,
                 )
                 # the admission-sampled first token is applied like a chunk
                 # log — deferred, so its fetch also overlaps device compute
@@ -3974,7 +4186,7 @@ class PipelineServer:
             self._span(
                 "admit", dur_s=dt_admit, slot=slot,
                 ids=[r.id for r in batch], bucket=bucket,
-                chunked=self._chunked(bucket), n=len(batch),
+                chunked=chunked, n=len(batch),
             )
             for r in batch:
                 if self._radix is not None and pfx is None and not is_emb:
@@ -3986,13 +4198,13 @@ class PipelineServer:
                     )
                 self._span(
                     "prefill", dur_s=dt_admit, req=r, slot=slot,
-                    bucket=bucket, chunked=self._chunked(bucket),
+                    bucket=bucket, chunked=chunked,
                     n=len(batch),
                     queue_wait_s=round(r.started_at - r.submitted_at, 6),
                 )
             logger.info(
                 "admit slot=%d ids=%s bucket=%d chunked=%s in_flight=%d",
-                slot, [r.id for r in batch], bucket, self._chunked(bucket),
+                slot, [r.id for r in batch], bucket, chunked,
                 sum(r is not None and not r.done for r in self._rows),
             )
         return admitted
@@ -4019,7 +4231,12 @@ class PipelineServer:
         blocks already resident in the arena, and ``serve_admit_finish``
         arms the slot with the prefix-inclusive total length."""
         Bs, bucket = prompts.shape
-        Sc = self.prefill_chunk
+        # a cp-forced radix-hit admission can arrive with a suffix bucket
+        # SMALLER than prefill_chunk (the forced-chunked path exists for
+        # shard residency, not length) — clamp so the single chunk covers
+        # exactly the bucket; bucket and prefill_chunk are both powers of
+        # two, so larger buckets still split into whole chunks
+        Sc = min(self.prefill_chunk, bucket)
         row0 = slot * Bs
         self._admitting_rows.update(range(row0, row0 + Bs))
         idx = np.arange(bucket, dtype=np.int32)[None, :]
@@ -4039,7 +4256,8 @@ class PipelineServer:
             "serve_prefill_chunk",
             (self.num_stages, Bs, self.capacity, Sc, self.tp,
              self.kv_block_size, attn, self.kv_dtype,
-             self.engine.cache_dtype),
+             self.engine.cache_dtype)
+            + ((self.cp,) if self.cp > 1 else ()),
         )
         n_valid = int(row_valid.sum())
         for ci, off in enumerate(range(0, bucket, Sc)):
@@ -4055,9 +4273,9 @@ class PipelineServer:
             self.state = serve_ops.serve_prefill_chunk(
                 self.cfg,
                 self.mesh,
-                self.engine.stage_layers,
-                self.engine.layer_masks,
-                self.engine.head_params,
+                self._stage_layers,
+                self._layer_masks,
+                self._head_params,
                 self.state,
                 jnp.asarray(prompts[:, off : off + Sc]),
                 jnp.asarray(positions[:, off : off + Sc]),
@@ -4070,6 +4288,7 @@ class PipelineServer:
                 cache_dtype=self.engine.cache_dtype,
                 prefix_off=jnp.asarray(prefix_off, jnp.int32),
                 attn=attn,
+                cp=self.cp,
             )
             # interleave only when some OTHER request is mid-decode — the
             # admitting rows themselves are in _rows already and must not
@@ -4079,15 +4298,16 @@ class PipelineServer:
                     "serve_chunk",
                     (self.num_stages, self.batch_per_slot, self.capacity,
                      self.num_stages, self._sampling, self._filtering,
-                     self.tp, self.kv_block_size, attn, self.kv_dtype),
+                     self.tp, self.kv_block_size, attn, self.kv_dtype)
+                    + ((self.cp,) if self.cp > 1 else ()),
                 )
                 self._flush_tables()
                 self.state, log = serve_ops.serve_chunk(
                     self.cfg,
                     self.mesh,
-                    self.engine.stage_layers,
-                    self.engine.layer_masks,
-                    self.engine.head_params,
+                    self._stage_layers,
+                    self._layer_masks,
+                    self._head_params,
                     self.state,
                     self.num_stages,
                     self.num_stages,  # one ring cycle between chunks
@@ -4096,6 +4316,7 @@ class PipelineServer:
                     tp=self.tp,
                     block_size=self.kv_block_size or 0,
                     attn=attn,
+                    cp=self.cp,
                 )
                 self._pending.append(
                     ("chunk",
@@ -4109,12 +4330,13 @@ class PipelineServer:
         carried = rng_mask is not None and bool(rng_mask.any())
         record_shape_key(
             "serve_admit_finish",
-            (self.num_stages, Bs, self.capacity, self.tp, carried),
+            (self.num_stages, Bs, self.capacity, self.tp, carried)
+            + ((self.cp,) if self.cp > 1 else ()),
         )
         self.state = serve_ops.serve_admit_finish(
             self.cfg,
             self.mesh,
-            self.engine.head_params,
+            self._head_params,
             self.state,
             jnp.asarray(last_tok),
             # prefix-inclusive totals: pos_slots / lengths / budget and
@@ -4133,6 +4355,7 @@ class PipelineServer:
                 (jnp.asarray(rngs), jnp.asarray(rng_mask))
                 if carried else None
             ),
+            cp=self.cp,
         )
         self._admitting_rows.difference_update(range(row0, row0 + Bs))
 
@@ -4183,7 +4406,12 @@ class PipelineServer:
                 "serve_verify",
                 (self.num_stages, Bs, self.capacity, K, self._sampling,
                  self._filtering, self.tp, self.kv_block_size, attn,
-                 self.kv_dtype),
+                 self.kv_dtype)
+                # cp appended only when sharded: cp=1 keys (and programs)
+                # predate cp and must stay byte-identical (speculation is
+                # gated at construction for cp > 1, so this is the guard's
+                # key, not a live path)
+                + ((self.cp,) if self.cp > 1 else ()),
             )
             def do_verify(slot=slot, draft=draft, draft_len=draft_len,
                           cache_delta=cache_delta):
@@ -4191,9 +4419,9 @@ class PipelineServer:
                 return serve_ops.serve_verify(
                     self.cfg,
                     self.mesh,
-                    self.engine.stage_layers,
-                    self.engine.layer_masks,
-                    self.engine.head_params,
+                    self._stage_layers,
+                    self._layer_masks,
+                    self._head_params,
                     self.state,
                     jnp.asarray(draft),
                     jnp.asarray(draft_len),
@@ -4206,6 +4434,7 @@ class PipelineServer:
                     tp=self.tp,
                     block_size=self.kv_block_size or 0,
                     attn=attn,
+                    cp=self.cp,
                 )
 
             self._flush_tables()

@@ -95,8 +95,8 @@ def test_dispatch_statics_pr12_regression(tmp_path):
         shutil.copy(os.path.join(PKG, rel), dst)
     src = (root / "runtime/server.py").read_text()
     mutated = src.replace(
-        "self.kv_block_size, attn, self.kv_dtype),",
-        "self.kv_block_size, self.kv_dtype),", 1,
+        "self.kv_block_size, attn, self.kv_dtype)",
+        "self.kv_block_size, self.kv_dtype)", 1,
     )
     assert mutated != src, "serve_chunk shape key moved — update the test"
     (root / "runtime/server.py").write_text(mutated)

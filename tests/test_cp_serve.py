@@ -1,0 +1,252 @@
+"""Context-parallel long-context serving (ISSUE 18).
+
+The contract under test: ``engine.serve(cp=N)`` shards the paged KV arena
+across N chip groups (one sub-arena + block-table plane per shard — see
+``parallel/serve._kv_spec`` and ``runtime/blocks.ShardedBlockAllocator``),
+chunked prefill lands each chunk's KV arena-native on its owner shard, and
+decode combines per-shard attention partials with the online-softmax
+recurrence (``ops/paged_attention.combine_attn_stats``) — so greedy output
+is TOKEN-IDENTICAL to the unsharded oracle on plain, chunked, radix-hit
+and sampled workloads, while the ADMISSIBLE context grows ~N-fold at fixed
+per-shard arena (the capacity test at the bottom is the point of the
+feature).
+
+cp=1 must stay byte-identical to the pre-cp serve path: the shape-key test
+asserts the cp=1 programs' jit keys carry no cp element (rollback is a
+flag flip, not a recompile of different programs).
+
+``PAGED_TEST_BLOCK_SIZE`` parameterizes the block size (CI reruns at 4
+under ``PAGED_FORCE_KERNEL=interpret``: every chunk straddles block seams
+and attention runs the kernel code path per shard).
+"""
+
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from llm_sharding_tpu.models import llama
+from llm_sharding_tpu.models.config import tiny_llama
+from llm_sharding_tpu.runtime.blocks import ShardedBlockAllocator
+from llm_sharding_tpu.runtime.engine import PipelineEngine
+from llm_sharding_tpu.runtime.generate import generate
+
+CFG = tiny_llama(num_hidden_layers=8, max_position_embeddings=512)
+BS = int(os.environ.get("PAGED_TEST_BLOCK_SIZE", "8"))
+CAP = 256
+CHUNK = 16
+
+# 2 stages x cp 4 = the whole 8-device CPU mesh at the widest setting
+STAGES = 2
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = llama.init_params(CFG, jax.random.key(11), dtype=jnp.float32)
+    eng = PipelineEngine(CFG, params, num_stages=STAGES,
+                         cache_dtype=jnp.float32)
+    return params, eng
+
+
+def oracle(params, p, n, **kw):
+    res = generate(CFG, params, p, n, cache_dtype=jnp.float32, **kw)
+    return [int(x) for x in res.tokens[0, len(p): int(res.lengths[0])]]
+
+
+def serve(eng, **kw):
+    kw.setdefault("capacity", CAP)
+    kw.setdefault("kv_block_size", BS)
+    # kv_blocks is PER SHARD: every cp setting gets the same per-shard
+    # arena, so the identity matrix also exercises growing global pools
+    kw.setdefault("kv_blocks", 4 * CAP // BS + 1)
+    kw.setdefault("prefill_chunk", CHUNK)
+    return eng.serve(**kw)
+
+
+def prompt(seed, n):
+    return np.random.default_rng(seed).integers(
+        1, CFG.vocab_size, n
+    ).astype(np.int32)
+
+
+def drive(srv, reqs):
+    while any(not r.done for r in reqs):
+        srv.step()
+    return [list(r.tokens) for r in reqs]
+
+
+# ----------------------------------------------------------- token identity
+
+
+@pytest.mark.parametrize("cp", [1, 2, 4])
+def test_cp_token_identity_plain_and_chunked(setup, cp):
+    """The acceptance oracle: one-shot admission (8 tokens, bucket 8),
+    chunked admission straddling block seams (56 tokens, 4 chunks) and a
+    mid-block prompt end (23), all greedy token-identical to the unsharded
+    monolith at every cp width."""
+    params, eng = setup
+    srv = serve(eng, cp=cp)
+    if cp > 1:
+        assert dict(zip(srv.mesh.axis_names, srv.mesh.devices.shape)) == {
+            "cp": cp, "pipe": STAGES,
+        }
+        assert isinstance(srv._alloc, ShardedBlockAllocator)
+    ps = [prompt(7, 56), prompt(8, 23), prompt(9, 8)]
+    reqs = [srv.submit(p, max_new_tokens=6) for p in ps]
+    toks = drive(srv, reqs)
+    for p, t in zip(ps, toks):
+        assert t == oracle(params, p, 6)
+    srv._alloc.check()
+    srv.close()
+
+
+@pytest.mark.parametrize("cp", [2, 4])
+def test_cp_radix_hit_admits_chunked_token_identical(setup, cp):
+    """Radix hits under cp are FORCED through the chunked ring-prefill path
+    (``_use_chunked``): the matched prefix is shard-resident arena KV, so a
+    one-shot gathered-window admit cannot assemble it. The hit must still
+    count as a hit (blocks reused, not re-prefetched cold) and decode
+    token-identically."""
+    params, eng = setup
+    srv = serve(eng, cp=cp, prefix_cache="hbm")
+    shared = prompt(21, 4 * BS)
+    p1 = np.concatenate([shared, prompt(22, 9)])
+    r1 = srv.submit(p1, max_new_tokens=6)
+    drive(srv, [r1])
+    assert r1.tokens == oracle(params, p1, 6)
+
+    hit0 = srv._radix.hit_tokens
+    p2 = np.concatenate([shared, prompt(23, 12)])  # short suffix: cp forces
+    r2 = srv.submit(p2, max_new_tokens=6)          # chunked anyway
+    drive(srv, [r2])
+    assert srv._radix.hit_tokens - hit0 == 4 * BS, (
+        "radix hit under cp fell back cold"
+    )
+    assert r2.tokens == oracle(params, p2, 6)
+    srv._alloc.check()
+    srv._radix.check()
+    srv.close()
+
+
+def test_cp_sampled_token_identity(setup):
+    """Sampled decoding: the per-request key chain is cp-REPLICATED (every
+    shard advances the same chain; only attention is sharded), so a seeded
+    sampled request draws the same tokens at cp=2 as the B=1 monolith."""
+    params, eng = setup
+    kw = dict(temperature=0.7, seed=123, top_k=20)
+    p = prompt(33, 40)
+    srv = serve(eng, cp=2)
+    r = srv.submit(p, max_new_tokens=8, **kw)
+    drive(srv, [r])
+    assert r.tokens == oracle(params, p, 8, **kw)
+    srv.close()
+
+
+# ------------------------------------------------- allocator chaos + audits
+
+
+def test_cp_allocator_clean_after_cancel_and_deadline(setup):
+    """Per-shard block accounting survives the ugly exits: a cancel
+    mid-decode and a deadline shed must return every private block to its
+    owner shard's free list (``ShardedBlockAllocator.check`` audits the
+    per-shard partition, pins and the reserved trash blocks)."""
+    params, eng = setup
+    srv = serve(eng, cp=2)
+    live = srv.submit(prompt(41, 30), max_new_tokens=12)
+    doomed = srv.submit(prompt(42, 56), max_new_tokens=64)
+    while not doomed.tokens:
+        srv.step()
+    assert srv.cancel(doomed)
+    shed = srv.submit(prompt(43, 24), max_new_tokens=8, deadline_s=1e-6)
+    drive(srv, [live])
+    assert live.tokens == oracle(params, prompt(41, 30), 12)
+    assert shed.done and shed.error is not None
+    assert srv._alloc.in_use == 0
+    srv._alloc.check()
+    srv.close()
+
+
+# ----------------------------------------------------- cp=1 program identity
+
+
+def test_cp1_shape_keys_have_no_cp_element(setup, monkeypatch):
+    """Rollback contract: cp=1 serving dispatches the EXACT pre-cp
+    programs. Keys recorded during a cp=1 run must be the cp=2 run's keys
+    with the trailing cp element stripped — i.e. cp=1 jit keys carry no cp
+    at all, so the flag off means zero new compiles."""
+    import llm_sharding_tpu.runtime.server as server_mod
+
+    params, eng = setup
+    seen = []
+    orig = server_mod.record_shape_key
+    monkeypatch.setattr(
+        server_mod, "record_shape_key",
+        lambda prog, key: (seen.append((prog, key)), orig(prog, key))[1],
+    )
+
+    def run_keys(cp):
+        seen.clear()
+        srv = serve(eng, cp=cp)
+        drive(srv, [srv.submit(prompt(51, 56), max_new_tokens=4)])
+        srv.close()
+        return {
+            (prog, key) for prog, key in seen if prog.startswith("serve_")
+        }
+
+    k1, k2 = run_keys(1), run_keys(2)
+    progs = {p for p, _ in k1}
+    assert {"serve_admit_finish", "serve_prefill_chunk",
+            "serve_chunk"} <= progs
+    assert all(key[-1] == 2 for _, key in k2)
+    assert {(p, key[:-1]) for p, key in k2} == k1
+
+
+# --------------------------------------------- the point: admissible length
+
+
+def test_cp2_admits_prompt_exceeding_one_shard_arena(setup):
+    """The capability the sharded arena buys: at EQUAL per-shard arena, a
+    prompt whose KV exceeds one shard's pool is a typed never-fits refusal
+    at cp=1 but admits and decodes token-identically at cp=2 (its blocks
+    striped across both shards)."""
+    params, eng = setup
+    per_shard = 11  # 10 usable blocks/shard = 80 slots at BS=8
+    blocks = dict(kv_blocks=per_shard, kv_block_size=BS)
+    # bucket(12*BS+4) = 16*BS, + decode + injected token: 17-18 blocks at
+    # either CI block size — over one shard's 10, under two shards' 20
+    p = prompt(61, 12 * BS + 4)
+    srv1 = serve(eng, cp=1, **blocks)
+    with pytest.raises(ValueError, match="KV blocks"):
+        srv1.submit(p, max_new_tokens=4)
+    srv1.close()
+
+    srv2 = serve(eng, cp=2, **blocks)
+    assert srv2._alloc.capacity_blocks == 2 * (per_shard - 1)
+    r = srv2.submit(p, max_new_tokens=4)
+    while not r.tokens:
+        srv2.step()  # admitted: its blocks are live, provably on BOTH shards
+    used = {srv2._alloc.owner(g) for row in srv2._row_blocks for g in row}
+    assert used == {0, 1}
+    drive(srv2, [r])
+    assert r.tokens == oracle(params, p, 4)
+    srv2._alloc.check()
+    srv2.close()
+
+
+# ------------------------------------------------------------ curated gates
+
+
+def test_cp_unsupported_combinations_are_typed(setup):
+    params, eng = setup
+    with pytest.raises(ValueError, match="paged"):
+        eng.serve(capacity=CAP, cp=2)  # dense + cp
+    with pytest.raises(NotImplementedError, match="speculate"):
+        serve(eng, cp=2, prefill_chunk=None, speculate=2)
+    srv = serve(eng, cp=2)
+    with pytest.raises(NotImplementedError, match="prefill_prefix"):
+        srv.prefill_prefix(prompt(71, 2 * BS))
+    with pytest.raises(NotImplementedError, match="snapshot"):
+        srv.snapshot()
+    srv.close()

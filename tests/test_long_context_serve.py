@@ -27,6 +27,10 @@ def oracle(params, p, n):
     return list(res.tokens[0, len(p): int(res.lengths[0])])
 
 
+@pytest.mark.slow  # ~10 min of 16k x 16k CPU attention; the 4k one-shot
+# test below keeps the admission ladder in the tier-1 gate, and the cp
+# suite (tests/test_cp_serve.py) covers chunked long-context admission at
+# tier-1 cost
 def test_long_prompt_chunked_admission_16k():
     params = llama.init_params(CFG, jax.random.key(29), dtype=jnp.float32)
     eng = PipelineEngine(CFG, params, num_stages=2, cache_dtype=jnp.float32)

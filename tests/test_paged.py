@@ -340,6 +340,30 @@ def test_oversized_request_typed_rejection(setup):
     check_drained(srv)
 
 
+def test_never_fits_prompt_typed_rejection_at_submit(setup):
+    """A prompt that can NEVER admit — longer than the largest admit bucket
+    the server's capacity allows, or whose positions would run past
+    max_position_embeddings — is a typed ValueError at ``submit()``, not a
+    forever-queued ghost (the long-context analogue of the block-ceiling
+    check above; under cp the admissible length grows, the refusal contract
+    does not change)."""
+    _, eng = setup
+    srv = eng.serve(capacity=64, **paged_kw())
+    # no admit bucket >= 200 fits capacity 64
+    with pytest.raises(ValueError, match="admit buckets"):
+        srv.submit(prompt(71, 200), 4)
+    assert len(srv._queue) == 0
+    check_drained(srv)
+    # position ceiling: capacity 256 > max_position_embeddings 128, so a
+    # request can fit the cache yet run past the rope table — bucket(50)=64
+    # plus 80 new tokens needs 144 positions
+    srv = eng.serve(capacity=256, **paged_kw(capacity=256))
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        srv.submit(prompt(72, 50), 80)
+    assert len(srv._queue) == 0
+    check_drained(srv)
+
+
 def test_embedding_oversized_with_pins_typed_rejection(setup):
     """``submit_embedding`` honors the same never-fits ceiling as
     ``submit()``: blocks pinned by a live prefix handle can only come back
