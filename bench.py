@@ -1304,6 +1304,110 @@ def bench_failover_serve(on_tpu, cfg, params, jax, jnp):
     )
 
 
+def bench_cp_failover_serve(on_tpu, cfg, params, jax, jnp):
+    """ISSUE 19 headline: resilience at cp=2. Extends the failover bench
+    to context-parallel replicas on the disaggregated topology — each dp
+    group runs a cp=2 sharded arena, prefill→decode hand-offs stream
+    per-shard blocks (``server_handoff_bytes_total`` growth is asserted
+    in-band: every streamed prefix crosses BOTH owner shards), then a
+    seeded ``replica_step`` fault kills the cp=2 decode replica mid-decode
+    and supervision migrates its live rows back to the survivor through
+    the cp-generalized extract/adopt path. The faulted run must stay
+    token-identical to the clean run (divergence raises — sharded
+    durability must not cost exactness); the emitted ratio is failover
+    cost at cp=2: detection + migration + the lost replica's capacity."""
+    from llm_sharding_tpu.obs.metrics import (
+        CP_STREAM_SHARDS, HANDOFF_BYTES, REQUESTS_MIGRATED,
+    )
+    from llm_sharding_tpu.runtime.disagg import DisaggServer
+    from llm_sharding_tpu.runtime.faults import FaultPlan
+
+    name = (
+        "serve_cp_failover_tok_s_llama3.2-3b_dp2" if on_tpu
+        else "serve_cp_failover_tok_s_tiny_cpu"
+    )
+    if on_tpu:
+        stages, n_req, prompt_len, max_new, kill_step = 1, 16, 160, 64, 6
+        bs, capacity = 64, 448
+    else:
+        stages, n_req, prompt_len, max_new, kill_step = 1, 6, 18, 16, 6
+        bs, capacity = 8, 64
+    need = 2 * 2 * stages  # dp2 x cp2 x stages
+    n_dev = len(jax.devices())
+    if n_dev < need:
+        emit_error(name, "tokens/sec",
+                   f"needs >= {need} devices for dp2 x cp2 x {stages} "
+                   f"stage(s) (have {n_dev})")
+        return
+    devices = jax.devices()[:need]
+
+    def run(plan):
+        srv = DisaggServer(
+            cfg, params, data_parallel=2, num_stages=stages, cp=2,
+            devices=devices, capacity=capacity, fault_plan=plan,
+            roles=["prefill", "decode"], kv_block_size=bs,
+            kv_blocks=8 * capacity // bs + 1, prefill_chunk=bs * 2,
+            prefix_cache="hbm",
+        )
+        rng = np.random.default_rng(13)
+        prompts = [
+            rng.integers(0, cfg.vocab_size, prompt_len).astype(np.int32)
+            for _ in range(n_req)
+        ]
+        reqs = [srv.submit(p, max_new_tokens=max_new) for p in prompts]
+        t0 = time.perf_counter()
+        srv.run_until_idle()
+        dt = time.perf_counter() - t0
+        toks = [list(r.tokens) for r in reqs]
+        assert all(r.error is None for r in reqs), [
+            (r.id, r.error) for r in reqs if r.error is not None
+        ]
+        n_live = len(srv.servers)
+        for s in srv.servers:
+            s._alloc.check()
+        srv.close()
+        del srv
+        gc.collect()
+        return sum(len(t) for t in toks) / dt, toks, n_live
+
+    run(None)  # compile admit/chunk/handoff programs for both replicas
+    bytes0 = HANDOFF_BYTES.value
+    shards0 = CP_STREAM_SHARDS.labels(outcome="ok").value
+    clean_tok_s, clean_toks, _ = run(None)
+    handoff_bytes = int(HANDOFF_BYTES.value - bytes0)
+    stream_shards = int(
+        CP_STREAM_SHARDS.labels(outcome="ok").value - shards0
+    )
+    if handoff_bytes <= 0 or stream_shards <= 0:
+        # in-band gate: at cp=2 every warm hand-off must move real bytes
+        # through per-shard streams — a zero here means the sharded path
+        # silently fell back to re-prefill and the headline is a lie
+        raise RuntimeError(
+            f"cp=2 hand-offs moved no sharded KV (handoff_bytes="
+            f"{handoff_bytes}, stream_shard_passes={stream_shards})"
+        )
+    migrated0 = REQUESTS_MIGRATED.labels(outcome="ok").value
+    plan = FaultPlan.permanent("replica_step", key=1, start=kill_step)
+    fault_tok_s, fault_toks, n_live = run(plan)
+    migrated = int(REQUESTS_MIGRATED.labels(outcome="ok").value - migrated0)
+    if fault_toks != clean_toks:
+        raise RuntimeError(
+            "cp=2 failover serve output diverged from the clean run "
+            f"({sum(len(t) for t in fault_toks)} vs "
+            f"{sum(len(t) for t in clean_toks)} tokens)"
+        )
+    emit(
+        name, fault_tok_s, "tokens/sec", fault_tok_s / ANCHOR_TOK_S,
+        clean_tok_s=round(clean_tok_s, 2),
+        recovered_frac=round(fault_tok_s / max(clean_tok_s, 1e-9), 3),
+        requests_migrated=migrated,
+        replicas_after=n_live,
+        handoff_bytes_clean=handoff_bytes,
+        cp_stream_shard_passes_clean=stream_shards,
+        token_identical=(fault_toks == clean_toks),
+    )
+
+
 def bench_disagg_serve(on_tpu, cfg, params, jax, jnp):
     """Disaggregated prefill/decode serving (runtime/disagg.py) vs unified
     dp2 on a MIXED workload: interactive short-prompt streams decoding
@@ -2503,6 +2607,10 @@ def main():
         "serve_tok_s_cp2_llama3.2-3b_1stage" if on_tpu
         else "serve_tok_s_cp2_tiny_cpu"
     )
+    ncpfail = (
+        "serve_cp_failover_tok_s_llama3.2-3b_dp2" if on_tpu
+        else "serve_cp_failover_tok_s_tiny_cpu"
+    )
 
     # section order = survival priority under a driver-side timeout:
     # 3B (anchor emitted immediately) → serve → 3B-int8 → pallas → 7B(+int8)
@@ -2717,6 +2825,17 @@ def main():
             except Exception as e:  # noqa: BLE001
                 emit_error(nfailover, "tokens/sec", e)
             gc.collect()
+        # cp=2 failover (sharded-arena replicas on the disagg topology:
+        # per-shard hand-off streams, then a mid-decode replica kill) —
+        # same own-engines-from-params3b rule as the dp failover above
+        if remaining() < 180:
+            emit_skip(ncpfail, "tokens/sec", 180)
+        else:
+            try:
+                bench_cp_failover_serve(on_tpu, cfg3b, params3b, jax, jnp)
+            except Exception as e:  # noqa: BLE001
+                emit_error(ncpfail, "tokens/sec", e)
+            gc.collect()
         # disaggregated prefill/decode (dp2 roles + KV hand-off) builds its
         # own replica engines from params3b too — also before int8 donates
         if remaining() < 180:
@@ -2792,6 +2911,8 @@ def main():
         emit_error(npaged, "tokens/sec", "not attempted: 3B section failed")
         emit_error(nradix, "tokens/sec", "not attempted: 3B section failed")
         emit_error(nfailover, "tokens/sec",
+                   "not attempted: 3B section failed")
+        emit_error(ncpfail, "tokens/sec",
                    "not attempted: 3B section failed")
         emit_error(ndisagg, "ms", "not attempted: 3B section failed")
         emit_error(nstepover, "percent_overhead",

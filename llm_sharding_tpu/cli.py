@@ -503,13 +503,8 @@ def cmd_serve(args) -> int:
             cp_bad = ("--cp needs paged KV serving "
                       "(--kv-block-size/--kv-blocks): context parallelism "
                       "shards the paged arena")
-        elif getattr(args, "data_parallel", 1) > 1:
-            cp_bad = "--cp with --data-parallel is not supported yet"
         elif getattr(args, "tensor_parallel", 1) > 1:
             cp_bad = "--cp with --tensor-parallel is not supported yet"
-        elif getattr(args, "disagg", False):
-            cp_bad = ("--cp with --disagg is not supported yet (cp-aware "
-                      "KV hand-off streaming is a roadmap item)")
         elif getattr(args, "speculate", 0):
             cp_bad = "--cp with --speculate is not supported yet"
         elif (getattr(args, "prefix_cache", "off") != "off"
@@ -700,6 +695,10 @@ def cmd_serve(args) -> int:
             host_pool_blocks=getattr(args, "host_pool_blocks", 0),
             gauge_sweep_every_s=getattr(args, "gauge_sweep_every", 0.0),
             min_replicas=getattr(args, "min_replicas", 1),
+            # context-parallel replicas: each replica's paged arena is
+            # sharded over cp chips of its own device group (dp × cp ×
+            # stages total)
+            cp=getattr(args, "cp", 1),
         )
         eng = srv.engines[0]
         extra = ""
@@ -782,6 +781,7 @@ def cmd_serve(args) -> int:
                     ("host_pool_blocks",
                      getattr(args, "host_pool_blocks", 0) or None,
                      srv.host_pool_blocks or None),
+                    ("cp", getattr(args, "cp", 1), srv.cp),
                 )
                 if got != used
             ]
@@ -1476,9 +1476,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="context parallelism for long-context serving (with "
         "--kv-block-size/--kv-blocks): shard the paged KV arena across N "
         "chip groups so the admissible context grows ~N-fold at fixed "
-        "per-chip HBM (devices = cp x stages). Chunked prefill runs ring "
-        "passes over shard-resident KV and decode combines per-shard "
-        "attention partials; greedy output stays token-identical to cp=1",
+        "per-chip HBM (devices = cp x stages; with --data-parallel, dp x "
+        "cp x stages). Chunked prefill runs ring passes over "
+        "shard-resident KV and decode combines per-shard attention "
+        "partials; greedy output stays token-identical to cp=1. Composes "
+        "with snapshots, migration/failover, --disagg and the host "
+        "prefix tier (per-shard block streaming)",
     )
     s.add_argument(
         "--min-replicas", type=int, default=1, dest="min_replicas",

@@ -683,6 +683,17 @@ DISAGG_HANDOFFS = REGISTRY.counter(
     "typed)",
     labels=("outcome",),
 )
+CP_STREAM_SHARDS = REGISTRY.counter(
+    "server_cp_stream_shards_total",
+    "Per-shard block-stream passes through a context-parallel paged arena "
+    "(reads that gather blocks from their owner shard and writes that land "
+    "blocks on the adopter's owner shard), by outcome (ok = the shard's "
+    "slice moved; error = the pass raised — injected cp_shard_stream "
+    "faults and real transfer failures both land here). Incremented only "
+    "at cp>1; each snapshot, hand-off, host-tier demote/restore, or "
+    "migration touches every owner shard of the rows it moves",
+    labels=("outcome",),
+)
 HANDOFF_BYTES = REGISTRY.counter(
     "server_handoff_bytes_total",
     "Host bytes of KV block data streamed between replicas (prefill→decode "

@@ -464,10 +464,15 @@ def gather_prefix_kv(
 @functools.partial(jax.jit, donate_argnums=(0, 1))
 def write_arena_blocks(k_arena, v_arena, blocks, k_host, v_host):
     """Write host-tier block KV back into the pooled arena (the radix
-    cache streaming a demoted node in on a hit): a block-axis scatter,
-    donated so the arena updates in place — restore never transiently
-    doubles the dominant HBM consumer. Bit-exact: the values written are
-    the bytes ``read`` pulled out (same cache dtype end to end)."""
+    cache streaming a demoted node in on a hit, a disagg hand-off landing
+    a streamed prefix): a block-axis scatter, donated so the arena
+    updates in place — restore never transiently doubles the dominant HBM
+    consumer. Bit-exact: the values written are the bytes ``read`` pulled
+    out (same cache dtype end to end). On a context-parallel arena (block
+    axis sharded over cp) ``blocks`` are GLOBAL ids — positions on the
+    logical concatenated axis — so GSPMD lands each block's write on
+    exactly its owner shard; the host tensors are tiny (a prefix's
+    blocks), so the replicated operand cost is noise next to the arena."""
     return (
         k_arena.at[:, :, blocks].set(k_host),
         v_arena.at[:, :, blocks].set(v_host),

@@ -290,6 +290,15 @@ class ShardedBlockAllocator(BlockAllocator):
         """Owning shard of a global block id."""
         return int(gid) // self.blocks_per_shard
 
+    def owner_shards(self, blocks) -> list[int]:
+        """Sorted distinct owner shards of a global block list — the
+        per-shard pass order of an arena block stream (snapshot capture,
+        hand-off, host-tier demote/restore): reads gather each listed
+        shard's slice, writes land each block on its owner, and a
+        ``cp_shard_stream`` fault keyed by one of these indices aborts
+        the stream at exactly that shard."""
+        return sorted({self.owner(b) for b in blocks})
+
     @property
     def capacity_blocks(self) -> int:
         """Allocatable blocks: each shard donates its local block 0."""

@@ -67,8 +67,8 @@ import numpy as np
 
 from ..analysis.lockorder import named_lock
 from ..obs.metrics import (
-    DISAGG_HANDOFFS, DISAGG_TTFT_ERROR, HANDOFF_BYTES, REPLICA_ROLES,
-    REPLICA_SPAWNS, set_replica_role,
+    CP_STREAM_SHARDS, DISAGG_HANDOFFS, DISAGG_TTFT_ERROR, HANDOFF_BYTES,
+    REPLICA_ROLES, REPLICA_SPAWNS, set_replica_role,
 )
 from .blocks import BlockExhausted
 from .faults import is_transient
@@ -508,6 +508,45 @@ class DisaggServer(ReplicatedServer):
                     logger.warning(
                         "kv_handoff fault for request %d: %r — decoding "
                         "stays on replica %d",
+                        req.id, e, self._group_of[src],
+                    )
+                return True
+        if self._fault_plan is not None and src.cp > 1:
+            # per-shard probe of the SHARDED stream before extract: the
+            # hand-off will walk every owner shard of the streamed
+            # prefix, and a shard that cannot serve its slice must
+            # defer or fall back while the request still lives on src —
+            # past extract the only containment left is a cold adopt.
+            # Classified exactly like kv_handoff: transient defers one
+            # sweep (retried), permanent keeps the request decoding on
+            # its prefill replica (fallback), token identity on both.
+            try:
+                for sh in range(src.cp):
+                    self._fault_plan.check("cp_shard_stream", key=sh)
+            except Exception as e:  # noqa: BLE001 — classified below
+                CP_STREAM_SHARDS.labels(outcome="error").inc()
+                if is_transient(e) and attempts < self.handoff_retries:
+                    self._pending_handoff[req] = attempts + 1
+                    DISAGG_HANDOFFS.labels(outcome="retried").inc()
+                    self._decision(
+                        "handoff", req=req, outcome="retried",
+                        attempts=attempts + 1,
+                    )
+                    logger.warning(
+                        "transient cp_shard_stream fault for request %d "
+                        "(attempt %d/%d): %r — retrying next sweep",
+                        req.id, attempts + 1, self.handoff_retries, e,
+                    )
+                else:
+                    self._no_handoff.add(req)
+                    DISAGG_HANDOFFS.labels(outcome="fallback").inc()
+                    self._decision(
+                        "handoff", req=req, outcome="fallback",
+                        reason="fault", attempts=attempts,
+                    )
+                    logger.warning(
+                        "cp_shard_stream fault for request %d: %r — "
+                        "decoding stays on replica %d",
                         req.id, e, self._group_of[src],
                     )
                 return True

@@ -67,6 +67,12 @@ SITES = (
     #                    next sweep (retried), permanent falls back to
     #                    decoding where the request already lives; token
     #                    identity must hold on every path
+    "cp_shard_stream", # one per-shard block-stream pass at cp>1 (keyed by
+    #                    the owner-shard index) — a fault here simulates one
+    #                    chip of a context-parallel arena failing to serve
+    #                    its slice of a streamed prefix; transient defers
+    #                    the hand-off (retried), permanent falls back to
+    #                    re-prefill on the destination
 )
 
 

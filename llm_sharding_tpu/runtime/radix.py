@@ -67,8 +67,8 @@ class RadixNode:
     demoted (never both)."""
 
     __slots__ = (
-        "key", "blocks", "host_kv", "children", "parent", "refs",
-        "last_used",
+        "key", "blocks", "host_kv", "host_owners", "children", "parent",
+        "refs", "last_used",
     )
 
     def __init__(self, key: np.ndarray, blocks, parent):
@@ -81,6 +81,14 @@ class RadixNode:
         # tuple back to write_kv verbatim, so the round trip is byte-exact
         # either way)
         self.host_kv: Optional[tuple] = None
+        # Shard-tagged component layout of a demoted node under
+        # context-parallel serving: ``host_owners[i]`` is the cp shard
+        # that owned block ``i`` of ``host_kv`` at demote time (None at
+        # cp=1 or without a ``block_owner`` callback). Purely descriptive
+        # — restore lands on fresh allocator-chosen owners — but it lets
+        # operators and the chaos suites byte-compare a demote/restore
+        # round trip per source shard.
+        self.host_owners: Optional[list] = None
         self.children: dict[int, "RadixNode"] = {}
         self.parent: Optional["RadixNode"] = parent
         self.refs = 0  # live rows pinning this node (admission ↔ release)
@@ -117,6 +125,7 @@ class RadixCache:
         host_pool_blocks: int = 0,
         read_kv: Optional[Callable] = None,   # (blocks) -> (k_np, v_np)
         write_kv: Optional[Callable] = None,  # (blocks, k_np, v_np) -> None
+        block_owner: Optional[Callable] = None,  # (gid) -> cp shard index
     ):
         if host_pool_blocks < 0:
             raise ValueError(
@@ -132,6 +141,7 @@ class RadixCache:
         self.host_pool_blocks = int(host_pool_blocks)
         self.read_kv = read_kv
         self.write_kv = write_kv
+        self.block_owner = block_owner
         self.root = RadixNode(np.zeros((0,), np.int32), [], None)
         self._tick = 0
         # running tallies (read lock-free by the gauge sweep — plain ints)
@@ -319,6 +329,9 @@ class RadixCache:
             top.host_kv = tuple(a[:, :, :nb] for a in child.host_kv)
             top.blocks = []
             child.host_kv = tuple(a[:, :, nb:] for a in child.host_kv)
+            if child.host_owners is not None:
+                top.host_owners = child.host_owners[:nb]
+                child.host_owners = child.host_owners[nb:]
         else:
             child.blocks = child.blocks[nb:]
         child.key = child.key[at_tokens:]
@@ -430,6 +443,10 @@ class RadixCache:
                 node.host_kv = tuple(
                     np.asarray(a) for a in self.read_kv(node.blocks)
                 )
+                if self.block_owner is not None:
+                    node.host_owners = [
+                        int(self.block_owner(b)) for b in node.blocks
+                    ]
                 self.alloc.unmark_cached(node.blocks)
                 self.alloc.free(node.blocks)
                 node.blocks = []
@@ -455,6 +472,7 @@ class RadixCache:
         self.alloc.mark_cached(blocks)
         node.blocks = blocks
         node.host_kv = None
+        node.host_owners = None
         self.host_blocks -= nb
         self.device_blocks += nb
         self.host_hit_tokens += int(node.key.shape[0])
@@ -476,6 +494,7 @@ class RadixCache:
         node.parent = None
         node.blocks = []  # a stale reference must never resurrect freed ids
         node.host_kv = None
+        node.host_owners = None
 
     def _drop_subtree(self, node: RadixNode) -> None:
         for c in list(node.children.values()):
@@ -589,12 +608,17 @@ class RadixCache:
                 order.append(c)
                 stack.append(c)
         for i, n in enumerate(order):
-            nodes.append({
+            meta = {
                 "parent": index[n.parent],
                 "blocks": [int(b) for b in n.blocks],
                 "tier": "hbm" if n.on_device() else "host",
                 "last_used": int(n.last_used),
-            })
+            }
+            if n.host_owners is not None:
+                # the shard-tagged layout survives the checkpoint so a
+                # restored cp server keeps the demote-time provenance
+                meta["owners"] = [int(s) for s in n.host_owners]
+            nodes.append(meta)
             arrays[f"radix.{i}.key"] = np.asarray(n.key, np.int32)
             if not n.on_device():
                 # one entry per host-KV component — kv0/kv1 are K and V,
@@ -639,6 +663,10 @@ class RadixCache:
                         np.asarray(arrays[f"radix.{i}.v"]),
                     )
                 node.blocks = []
+                node.host_owners = (
+                    None if meta.get("owners") is None
+                    else [int(s) for s in meta["owners"]]
+                )
                 self.host_blocks += key.shape[0] // self.block_size
             else:
                 self.alloc.mark_cached(node.blocks)

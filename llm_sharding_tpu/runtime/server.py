@@ -75,7 +75,7 @@ import jax.numpy as jnp
 
 from ..obs.metrics import (
     ARENA_BYTES, ATTN_BACKEND, ATTN_BACKENDS, ATTN_BLOCKS_READ,
-    DEFAULT_RATE_BUCKETS,
+    CP_STREAM_SHARDS, DEFAULT_RATE_BUCKETS,
     KV_BLOCKS_IN_USE, KV_BLOCKS_TOTAL, KV_HOST_TIER_BLOCKS, KV_WASTE_FRAC,
     PREFILL_BLOCKS_READ, PREFIX_HIT_RATE, PREFIX_HIT_TOKENS, REGISTRY,
     record_shape_key, set_prefill_path,
@@ -1223,13 +1223,6 @@ class PipelineServer:
                     "speculative on cp=1, or long-context on cp without "
                     "speculation (ROADMAP: cp-aware speculation)"
                 )
-            if self.prefix_cache == "host":
-                raise NotImplementedError(
-                    "cp > 1 with prefix_cache='host': the host tier's "
-                    "block save/restore round-trip is not cp-aware yet — "
-                    "use prefix_cache='hbm' (the radix tree itself is "
-                    "cp-safe: blocks stay shard-resident on hits)"
-                )
             if self.prefix_cache != "off" and self.prefill_chunk is None:
                 raise ValueError(
                     "cp > 1 with prefix_cache needs prefill_chunk: a radix "
@@ -1245,7 +1238,13 @@ class PipelineServer:
                 )
             from ..parallel.mesh import pipeline_cp_mesh
 
-            self.mesh = pipeline_cp_mesh(self.cp, self.num_stages)
+            # honor the engine's device group (a ReplicatedServer spawns
+            # each cp replica over its own slice of the machine — building
+            # the mesh from the global device list would pile every
+            # replica onto the same leading chips)
+            self.mesh = pipeline_cp_mesh(
+                self.cp, self.num_stages, getattr(engine, "_devices", None)
+            )
             place = lambda tree: jax.tree.map(
                 lambda a: jax.device_put(
                     a, jax.sharding.NamedSharding(self.mesh, a.sharding.spec)
@@ -1331,6 +1330,13 @@ class PipelineServer:
                 ),
                 read_kv=self._read_arena_blocks,
                 write_kv=self._write_arena_blocks,
+                # cp>1: demoted host-pool nodes carry a shard-tagged
+                # component layout (which shard owned each block at
+                # demote time) — descriptive provenance the chaos suites
+                # byte-compare per shard
+                block_owner=(
+                    self._alloc.owner if self.cp > 1 else None
+                ),
             )
         else:
             self._radix = None
@@ -1681,14 +1687,6 @@ class PipelineServer:
         with self._mutex:
             if self._closed:
                 raise ServerClosed("cannot snapshot a closed server")
-            if self.cp > 1:
-                raise NotImplementedError(
-                    "snapshot does not support context-parallel serving "
-                    "(cp > 1): serve_kwargs do not yet carry the cp axis, "
-                    "so a restored server would silently rebuild the arena "
-                    "unsharded. Drain and re-serve, or snapshot a cp=1 "
-                    "server."
-                )
             if self._admitting_rows:
                 raise RuntimeError(
                     "snapshot mid-chunked-admission is not supported — "
@@ -1750,15 +1748,21 @@ class PipelineServer:
                 return d
 
             return {
-                # format 5: adds inflight_steps to serve_kwargs (the async
-                # executor depth rides the checkpoint like every serve
-                # kwarg — snapshot-wins on restore) — bumped so a pre-
-                # async-executor reader's format gate refuses cleanly
-                # instead of crashing on the unknown kwarg. Format 4 added
-                # kv_dtype + the scale-arena/radix host-KV keys, format 3
-                # the prefix-cache section; formats 1 (dense) through 4
-                # still restore — see ``restore``
-                "format": 5,
+                # format 6: adds cp to serve_kwargs (the context-parallel
+                # shard count rides the checkpoint — snapshot-wins on
+                # restore, and a pre-cp reader's format gate refuses
+                # cleanly instead of silently rebuilding the arena
+                # unsharded). The device state/table leaves need no new
+                # keys: the single-controller np.asarray capture
+                # materializes the logically concatenated arena, and the
+                # host table mirror already keeps GLOBAL block ids — the
+                # ShardedBlockAllocator partition is a pure function of
+                # (cp, kv_blocks) plus the per-row lists, so restore
+                # rebuilds it exactly. Format 5 added inflight_steps,
+                # format 4 kv_dtype + the scale-arena/radix host-KV keys,
+                # format 3 the prefix-cache section; formats 1 (dense)
+                # through 5 still restore — see ``restore``
+                "format": 6,
                 "radix": (
                     None if self._radix is None else self._radix.snapshot()
                 ),
@@ -1792,6 +1796,10 @@ class PipelineServer:
                     paged_attn=self.paged_attn,
                     prefix_cache=self.prefix_cache,
                     host_pool_blocks=self.host_pool_blocks,
+                    # the cp shard count: restore refuses a mesh it cannot
+                    # rebuild (cp×stages devices) rather than silently
+                    # reshaping the arena
+                    cp=self.cp,
                 ),
                 # block ownership travels with the checkpoint: restore
                 # rebuilds the allocator's free list/refcounts from the
@@ -1828,12 +1836,32 @@ class PipelineServer:
         of an unsupported model family, raises the curated
         ``NotImplementedError`` instead of an obscure mesh/sharding error
         deep in the first dispatched program."""
-        if snap.get("format") not in (1, 2, 3, 4, 5):
+        if snap.get("format") not in (1, 2, 3, 4, 5, 6):
             raise ValueError(f"unknown snapshot format {snap.get('format')!r}")
         validate = getattr(engine, "_validate_serve", None)
         if validate is not None:
             validate()
         kwargs = dict(snap["serve_kwargs"])
+        # pre-format-6 snapshots lack the key and restore as cp=1 via the
+        # constructor default; a cp>1 snapshot refuses up front when the
+        # restoring engine cannot host the mesh — the arena leaves were
+        # captured against a cp-sharded placement and restoring them onto
+        # fewer shards would need a resharding pass this path does not do
+        cp = int(kwargs.get("cp", 1) or 1)
+        if cp > 1:
+            devs = getattr(engine, "_devices", None)
+            have = len(devs) if devs is not None else len(jax.devices())
+            stages = int(engine.mesh.shape[PIPE_AXIS])
+            if cp * stages > have:
+                raise ValueError(
+                    f"snapshot was taken at cp={cp} but the restoring "
+                    f"engine has {have} device(s) for {stages} pipeline "
+                    f"stage(s) — a context-parallel restore needs "
+                    f"cp×stages={cp * stages} devices on the same "
+                    "topology. Restore on a matching mesh, or move the "
+                    "live requests instead: extract/adopt re-admits them "
+                    "on a survivor of any cp."
+                )
         # dense/paged are different device layouts — the mismatch gets a
         # curated refusal up front, not a shape error deep in the leaf loop
         paged = kwargs.get("kv_block_size") is not None
@@ -1997,6 +2025,13 @@ class PipelineServer:
             srv._row_shared = [
                 [int(x) for x in b] for b in pg["row_shared"]
             ]
+            if srv.cp > 1:
+                # the snapshot's device leaf already carries the
+                # per-shard local planes, but re-projecting the restored
+                # GLOBAL mirror is what proves host and device agree —
+                # and keeps restore correct if the leaf predates a
+                # projection-rule change
+                srv._push_tables()
             rsnap = snap.get("radix")
             # the radix tree's device-tier nodes are block OWNERS exactly
             # like rows' private lists; host-tier nodes hold no device
@@ -3041,6 +3076,26 @@ class PipelineServer:
 
     # ------------------------------------ automatic prefix cache internals
 
+    def _cp_stream_check(self, blocks) -> None:
+        """Per-shard accounting for one block stream through the
+        cp-sharded arena: a ``cp_shard_stream`` fault probe (keyed by the
+        owner-shard index) plus a ``server_cp_stream_shards_total`` sample
+        per owner shard touched. A no-op at cp=1 — the unsharded paths
+        keep their exact fault-call sequences. A shard whose probe raises
+        records ``outcome=error`` and aborts the whole stream before any
+        device work is enqueued: the caller (hand-off sweep, host-tier
+        demote/restore, migration) classifies transient vs permanent and
+        retries or falls back, never half-streams."""
+        if self.cp <= 1:
+            return
+        for sh in self._alloc.owner_shards(blocks):
+            try:
+                self._fault_check("cp_shard_stream", key=sh)
+            except BaseException:
+                CP_STREAM_SHARDS.labels(outcome="error").inc()
+                raise
+            CP_STREAM_SHARDS.labels(outcome="ok").inc()
+
     def _read_arena_blocks_dispatch(self, blocks) -> tuple:
         """Dispatch-only half of ``_read_arena_blocks``: enqueue the
         block gathers and return DEVICE arrays (call ``np.asarray`` on
@@ -3049,16 +3104,18 @@ class PipelineServer:
         in enqueue order, so the gather reads the bytes as of this
         dispatch — which is what lets the disagg hand-off sidecar pull
         the device→host copy off the router's step thread without
-        freezing this server's pump for the copy's duration."""
-        if self.cp > 1:
-            raise NotImplementedError(
-                "arena block reads do not support context-parallel serving "
-                "(cp > 1): gathering by GLOBAL block id across the "
-                "cp-sharded arena needs per-shard local-id translation "
-                "(cp-aware hand-off streaming — see ROADMAP). The host "
-                "radix tier and disagg hand-off are gated off under cp."
-            )
-        idx = jnp.asarray(np.asarray(list(blocks), np.int32))
+        freezing this server's pump for the copy's duration.
+
+        cp > 1: global ids index the LOGICAL concatenated block axis
+        (``gid = owner*kv_blocks + local`` is exactly the position of the
+        owner shard's local block in axis 2 of the global array), so the
+        take below gathers each block from its owner shard — GSPMD turns
+        it into per-shard slices + a concat. ``_cp_stream_check`` walks
+        the owner shards first for fault injection and stream
+        accounting."""
+        blocks = list(blocks)
+        self._cp_stream_check(blocks)
+        idx = jnp.asarray(np.asarray(blocks, np.int32))
         out = [
             jnp.take(self.state.k, idx, axis=2),
             jnp.take(self.state.v, idx, axis=2),
@@ -3088,15 +3145,17 @@ class PipelineServer:
         arena slots (donating scatter — the arena never transiently
         doubles). Dispatch order makes it safe: the write precedes any
         program that could attend the restored blocks. Quantized arenas
-        restore the scale components alongside the codes, byte-exact."""
-        if self.cp > 1:
-            raise NotImplementedError(
-                "arena block writes do not support context-parallel "
-                "serving (cp > 1): scattering by GLOBAL block id into the "
-                "cp-sharded arena needs per-shard local-id translation "
-                "(cp-aware hand-off streaming — see ROADMAP)."
-            )
-        idx = jnp.asarray(np.asarray(list(blocks), np.int32))
+        restore the scale components alongside the codes, byte-exact.
+
+        cp > 1: the freshly allocated global ids address the logical
+        concatenated block axis, so the donating scatter lands each block
+        on the shard the allocator chose as its owner (same global-id
+        arithmetic as the read path; block bytes are cp-agnostic, which
+        is what lets a cp=1 peer's stream land on a cp=2 arena and vice
+        versa)."""
+        blocks = list(blocks)
+        self._cp_stream_check(blocks)
+        idx = jnp.asarray(np.asarray(blocks, np.int32))
         if self.kv_quantized:
             ks_host, vs_host = scales
             k_new, v_new, ks_new, vs_new = serve_ops.write_arena_blocks_q(
@@ -3262,15 +3321,14 @@ class PipelineServer:
         On a SPECULATIVE sampled server the device chain advances per
         verify step, not per token, so the recomputed chain is a fresh
         deterministic continuation rather than the unfaulted run's exact
-        draws (greedy spec rows stay token-identical either way)."""
-        if self.cp > 1:
-            raise NotImplementedError(
-                "extract does not support context-parallel serving "
-                "(cp > 1): migrating a request off a cp-sharded server "
-                "needs cp-aware hand-off streaming (see ROADMAP) — the "
-                "adopter would re-prefill against a differently-sharded "
-                "arena."
-            )
+        draws (greedy spec rows stay token-identical either way).
+
+        cp-safe: the portable state is host-side (prompt + applied
+        tokens, no KV), row blocks free through the sharded allocator,
+        and any radix insert on release reads the row's blocks
+        shard-aware through ``_read_arena_blocks`` — so the adopter may
+        run at ANY cp (a different-cp survivor re-admits through chunked
+        prefill and regenerates nothing the consumer saw)."""
         with self._mutex:
             if settle is None:
                 settle = (
@@ -3377,13 +3435,9 @@ class PipelineServer:
         ``front=True`` (default) queues it ahead of fresh submissions —
         migrated requests are the oldest work in the system. Deliberately
         NOT gated on ``max_queue``: migration moves existing load, it does
-        not add any."""
-        if self.cp > 1:
-            raise NotImplementedError(
-                "adopt does not support context-parallel serving (cp > 1): "
-                "a cp-sharded server cannot yet receive migrated requests "
-                "(cp-aware hand-off streaming — see ROADMAP)."
-            )
+        not add any. A cp-sharded adopter works like any other: the
+        resumed prompt re-admits through chunked prefill against ITS
+        arena partition, whatever cp the source ran."""
         with self._mutex:
             if self._closed:
                 _M_REJECTED.labels(reason="closed").inc()

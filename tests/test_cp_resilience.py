@@ -1,0 +1,529 @@
+"""Long-context resilience: the PR-3/5/10 durability/mobility machinery at
+cp>1 (ISSUE 19).
+
+ISSUE 18 bought context-parallel correctness by refusal: at cp>1 the
+server raised typed errors on ``snapshot()``, ``extract``/``adopt``, the
+arena block read/write primitives and the host radix tier. This suite
+pins the contract that retired those gates: every durability and mobility
+path that works at cp=1 works SHARDED, token-identically —
+
+- snapshot format 6 (carries ``cp``) auto-written mid-decode, process
+  killed, restored token-exactly; quantized and plain arenas alike; a
+  cp-mismatched restore refuses with a curated message;
+- dp failover of a cp=2 replica mid-decode migrates every live row
+  token-identically (allocator + tree ``check()`` on every replica);
+- disagg hand-off from a cp=2 prefill replica streams per-shard blocks
+  (``outcome=ok``, ``server_handoff_bytes_total`` grows, ZERO re-prefill
+  FLOPs on the decode side);
+- the seeded ``cp_shard_stream`` fault site (keyed by owner-shard index)
+  classifies transient→retried / permanent→fallback through the existing
+  hand-off outcome counters;
+- host-tier demote→restore round-trips byte-exactly per source shard
+  (demoted nodes carry a shard-tagged component layout);
+- and the retired gates are DELETED, not bypassed (source audit), while
+  the remaining legitimate gates (cp×tp, cp speculation) keep their
+  curated wording.
+
+``SERVE_TEST_INFLIGHT=2`` reruns the module with the async executor
+overlapped (CI's cp lane adds ``SHARDLINT_LOCK_ORDER=1`` and
+``PAGED_FORCE_KERNEL=interpret`` — cp × async executor × kernel path with
+the lock tracker hot).
+"""
+
+import ast
+import inspect
+import os
+import textwrap
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from llm_sharding_tpu.models import llama
+from llm_sharding_tpu.models.config import tiny_llama
+from llm_sharding_tpu.obs.metrics import (
+    CP_STREAM_SHARDS, DISAGG_HANDOFFS, HANDOFF_BYTES, REGISTRY,
+)
+from llm_sharding_tpu.runtime.blocks import ShardedBlockAllocator
+from llm_sharding_tpu.runtime.disagg import DisaggServer
+from llm_sharding_tpu.runtime.engine import PipelineEngine
+from llm_sharding_tpu.runtime.faults import FaultPlan
+from llm_sharding_tpu.runtime.generate import generate
+from llm_sharding_tpu.runtime.replicated import ReplicatedServer
+from llm_sharding_tpu.runtime.server import PipelineServer, load_snapshot
+
+CFG = tiny_llama(num_hidden_layers=8, max_position_embeddings=512)
+BS = int(os.environ.get("PAGED_TEST_BLOCK_SIZE", "8"))
+CAP = 128
+CHUNK = 16
+STAGES = 2
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = llama.init_params(CFG, jax.random.key(19), dtype=jnp.float32)
+    eng = PipelineEngine(CFG, params, num_stages=STAGES,
+                         cache_dtype=jnp.float32)
+    return params, eng
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _inflight_env():
+    """``SERVE_TEST_INFLIGHT=N`` reruns the module with the async executor
+    at depth N (the CI cp lane sets 2): every snapshot/migration/hand-off
+    here must hold while overlapped dispatches are in flight."""
+    depth = int(os.environ.get("SERVE_TEST_INFLIGHT", "1") or "1")
+    if depth <= 1:
+        yield
+        return
+    orig = PipelineEngine.serve
+
+    def serve(self, **kw):
+        kw.setdefault("inflight_steps", depth)
+        return orig(self, **kw)
+
+    PipelineEngine.serve = serve
+    try:
+        yield
+    finally:
+        PipelineEngine.serve = orig
+
+
+def oracle(params, p, n, **kw):
+    res = generate(CFG, params, p, n, cache_dtype=jnp.float32, **kw)
+    return [int(x) for x in res.tokens[0, len(p): int(res.lengths[0])]]
+
+
+def serve(eng, **kw):
+    kw.setdefault("capacity", CAP)
+    kw.setdefault("kv_block_size", BS)
+    kw.setdefault("kv_blocks", 4 * CAP // BS + 1)  # per shard
+    kw.setdefault("prefill_chunk", CHUNK)
+    return eng.serve(**kw)
+
+
+def prompt(seed, n):
+    return np.random.default_rng(seed).integers(
+        1, CFG.vocab_size, n
+    ).astype(np.int32)
+
+
+def drive(srv, reqs):
+    while any(not r.done for r in reqs):
+        srv.step()
+
+
+def handoff_tally():
+    return {
+        k: DISAGG_HANDOFFS.labels(outcome=k).value
+        for k in ("ok", "cold", "retried", "fallback", "no_target", "failed")
+    }
+
+
+def stream_tally():
+    return {
+        o: CP_STREAM_SHARDS.labels(outcome=o).value for o in ("ok", "error")
+    }
+
+
+# ------------------------------------------------ snapshot → kill → restore
+
+
+@pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+def test_cp_autosnapshot_kill_restore_token_exact(setup, tmp_path, kv_dtype):
+    """THE cp durability gate: a cp=2 server auto-snapshots mid-decode
+    (format 6: serve_kwargs carry cp, the table planes and the sharded
+    allocator partition ride the per-row lists), the daemon dies, and a
+    fresh server restored from disk finishes every in-flight request —
+    greedy AND seeded-sampled — token-identically to the uninterrupted
+    oracle, on plain and quantized arenas alike."""
+    params, eng = setup
+    snap_dir = str(tmp_path / f"auto-{kv_dtype}")
+    srv = serve(
+        eng, cp=2, kv_dtype=kv_dtype,
+        snapshot_every_s=0.0, snapshot_path=snap_dir,
+    )
+    pa, pb = prompt(61, 7 * BS), prompt(62, 23)
+    ra = srv.submit(pa, max_new_tokens=12)
+    rb = srv.submit(pb, max_new_tokens=10, temperature=0.9, seed=8)
+    for _ in range(5):
+        srv.step()  # mid-decode; an auto-snapshot lands after every step
+    streamed = {ra.id: list(ra.tokens), rb.id: list(rb.tokens)}
+    srv.close()  # the "crash": the daemon dies between steps
+
+    snap = load_snapshot(snap_dir)
+    assert snap["format"] == 6
+    assert snap["serve_kwargs"]["cp"] == 2
+    assert snap["serve_kwargs"]["kv_dtype"] == kv_dtype
+    srv2 = PipelineServer.restore(eng, snap)
+    assert srv2.cp == 2
+    assert isinstance(srv2._alloc, ShardedBlockAllocator)
+    revived = {
+        r.id: r for r in srv2._rows + list(srv2._queue) if r is not None
+    }
+    # already-streamed tokens replay into the revived requests, no dup/loss
+    for rid, toks in streamed.items():
+        assert revived[rid].tokens[: len(toks)] == toks
+    srv2.run_until_idle()
+    if kv_dtype == "bf16":
+        assert revived[ra.id].tokens == oracle(params, pa, 12)
+        assert revived[rb.id].tokens == oracle(
+            params, pb, 10, temperature=0.9, seed=8
+        )
+    else:
+        # the quantized oracle is the UNINTERRUPTED quantized run (int8
+        # codes round differently from the fp32 monolith by design)
+        full = serve(eng, cp=2, kv_dtype=kv_dtype)
+        fa = full.submit(pa, max_new_tokens=12)
+        fb = full.submit(pb, max_new_tokens=10, temperature=0.9, seed=8)
+        drive(full, [fa, fb])
+        assert revived[ra.id].tokens == fa.tokens
+        assert revived[rb.id].tokens == fb.tokens
+        full.close()
+    srv2._alloc.check()
+    srv2.close()
+
+
+def test_cp_snapshot_restore_reprojects_tables_and_allocator(setup):
+    """The restored daemon's host/device table agreement and allocator
+    partition are audited directly: global ids in the host mirror, local
+    per-shard planes on device, per-shard free lists exactly partitioning
+    the unheld pool."""
+    params, eng = setup
+    srv = serve(eng, cp=2)
+    r = srv.submit(prompt(63, 5 * BS + 3), max_new_tokens=8)
+    for _ in range(3):
+        srv.step()
+    snap = srv.snapshot()
+    srv.close()
+    srv2 = PipelineServer.restore(eng, snap)
+    # host mirror keeps GLOBAL ids; the row must really span both shards
+    row = next(q.row for q in srv2._rows if q is not None)
+    owners = {srv2._alloc.owner(g) for g in srv2._row_blocks[row]}
+    assert owners == {0, 1}
+    # device planes are the projection of the restored mirror
+    dev = np.asarray(srv2.state.block_tables)
+    nb = srv2.kv_blocks
+    g = srv2._tables[None]
+    sh = np.arange(srv2.cp, dtype=np.int32)[:, None, None]
+    np.testing.assert_array_equal(
+        dev, np.where(g // nb == sh, g % nb, 0).astype(np.int32)
+    )
+    srv2._alloc.check()
+    revived = {q.id: q for q in srv2._rows if q is not None}
+    srv2.run_until_idle()
+    assert revived[r.id].tokens == oracle(
+        params, prompt(63, 5 * BS + 3), 8
+    )
+    srv2.close()
+
+
+def test_cp_mismatched_restore_refused_curated(setup):
+    """A cp=2 snapshot refuses to restore onto an engine that cannot host
+    the cp×stages mesh — a curated ValueError naming the topology, not a
+    sharding error deep in the first dispatch."""
+    params, eng = setup
+    srv = serve(eng, cp=2)
+    srv.submit(prompt(64, 3 * BS), max_new_tokens=6)
+    srv.step()
+    snap = srv.snapshot()
+    srv.close()
+    small = PipelineEngine(
+        CFG, params, num_stages=STAGES, cache_dtype=jnp.float32,
+        devices=jax.devices()[:STAGES],  # cp×stages needs 4, has 2
+    )
+    with pytest.raises(ValueError, match=r"cp×stages|context-parallel"):
+        PipelineServer.restore(small, snap)
+
+
+# ---------------------------------------------------------- dp failover
+
+
+def test_cp_replica_failover_mid_decode_token_exact(setup):
+    """dp failover of a cp=2 replica: a seeded permanent ``replica_step``
+    fault kills replica 0 mid-decode; every live row it owned — greedy
+    and seeded-sampled — finishes token-identically on the cp=2 survivor
+    (extract settles, blocks free shard-aware, adopt re-admits through
+    chunked prefill), with allocator/tree ``check()`` clean on every
+    replica. Each replica's cp mesh must sit on ITS device group — the
+    regression this pins is every replica sharding over the same leading
+    chips."""
+    params, _ = setup
+    plan = FaultPlan.permanent("replica_step", key=0, start=4)
+    srv = ReplicatedServer(
+        CFG, params, data_parallel=2, num_stages=STAGES, cp=2,
+        cache_dtype=jnp.float32, fault_plan=plan,
+        capacity=CAP, kv_block_size=BS, kv_blocks=4 * CAP // BS + 1,
+        prefill_chunk=CHUNK, prefix_cache="hbm",
+    )
+    assert all(s.cp == 2 for s in srv.servers)
+    groups = [
+        {d.id for d in s.mesh.devices.flat} for s in srv.servers
+    ]
+    assert groups[0].isdisjoint(groups[1]), (
+        "cp replicas built their meshes over the same devices"
+    )
+    rng = np.random.default_rng(41)
+    prompts = [
+        rng.integers(1, CFG.vocab_size, int(n)).astype(np.int32)
+        for n in rng.integers(3 * BS, 7 * BS, 4)
+    ]
+    kws = [dict(temperature=1.1, seed=7, top_k=5)] + [{}] * 3
+    reqs = [srv.submit(p, 12, **kw) for p, kw in zip(prompts, kws)]
+    assert len({srv._owner[r] for r in reqs}) == 2
+    srv.run_until_idle()
+    assert len(srv.servers) == 1  # replica 0 really died
+    for r, p, kw in zip(reqs, prompts, kws):
+        assert r.error is None, (r.id, r.error)
+        assert r.tokens == oracle(params, p, 12, **kw), (
+            f"req {r.id} diverged after cp failover"
+        )
+    for s in srv.servers:
+        s._alloc.check()
+        if s._radix is not None:
+            s._radix.check()
+    srv.close()
+
+
+# ------------------------------------------------------- disagg hand-off
+
+
+def make_dsrv(params, **kw):
+    kw.setdefault("kv_block_size", BS)
+    kw.setdefault("kv_blocks", 6 * CAP // BS + 1)
+    kw.setdefault("prefix_cache", "hbm")
+    kw.setdefault("prefill_chunk", CHUNK)
+    return DisaggServer(
+        CFG, params, data_parallel=2, num_stages=STAGES, cp=2,
+        cache_dtype=jnp.float32, capacity=CAP,
+        roles=["prefill", "decode"], **kw,
+    )
+
+
+def test_cp_disagg_handoff_streams_per_shard_zero_reprefill(setup):
+    """ACCEPTANCE: a hand-off from a cp=2 prefill replica streams
+    per-shard blocks (``outcome=ok``, ``server_handoff_bytes_total`` and
+    the per-shard stream counter grow) and the cp=2 decode replica
+    performs ZERO re-prefill FLOPs for the streamed prefix. Unlike cp=1
+    (where adoption uses the gathered-window path and ``_admit_chunked``
+    can simply be booby-trapped), cp forces radix-hit admissions through
+    the chunked path for shard residency — so the trap here asserts every
+    decode-side chunked admit is SUFFIX-ONLY: ``prefix_off`` covers the
+    full block-aligned streamed prompt and chunks run over the tail
+    alone."""
+    params, _ = setup
+    srv = make_dsrv(params)
+    assert all(s.cp == 2 for s in srv.servers)
+
+    admits = []
+    dec = [s for s in srv.servers if srv.role_of(s) == "decode"]
+    for s in dec:
+        orig = s._admit_chunked
+
+        def trap(slot, prompts, plen, *a, __orig=orig, **kw):
+            admits.append((int(np.max(plen)), int(kw.get("prefix_off", 0))))
+            return __orig(slot, prompts, plen, *a, **kw)
+
+        s._admit_chunked = trap
+    before, hb0, cs0 = handoff_tally(), HANDOFF_BYTES.value, stream_tally()
+    prompts = [prompt(71, 4 * BS + 5), prompt(73, 2 * BS + 2)]
+    # distinct first tokens: prompts sharing a first token but diverging
+    # mid-block abandon the release-time radix insert (by design), which
+    # would make the second hand-off legitimately cold
+    assert prompts[0][0] != prompts[1][0]
+    kws = [{}, dict(temperature=0.9, seed=3)]
+    reqs = []
+    for p, kw in zip(prompts, kws):
+        r = srv.submit(p, 8, **kw)
+        reqs.append(r)
+        # admit each in its own batch: a shorter prompt CO-admitted with a
+        # longer one skips the source-side radix insert (pre-existing
+        # cp=1 semantics — the hand-off then correctly lands cold), and
+        # this test pins the WARM per-shard stream
+        while not r.tokens:
+            srv.step()
+    srv.run_until_idle()
+    for r, p, kw in zip(reqs, prompts, kws):
+        assert r.error is None, (r.id, r.error)
+        assert r.tokens == oracle(params, p, 8, **kw), f"req {r.id}"
+    after, cs1 = handoff_tally(), stream_tally()
+    assert after["ok"] - before["ok"] == len(reqs), (before, after)
+    assert after["cold"] == before["cold"]
+    assert HANDOFF_BYTES.value > hb0
+    # every decode-side admit reused the streamed blocks: chunks ran only
+    # over the (sub-block) tail, never the handed-off prefix
+    for suffix_len, prefix_off in admits:
+        assert prefix_off > 0 and suffix_len <= BS, (suffix_len, prefix_off)
+    aligned = sum(((len(p) - 1) // BS) * BS for p in prompts)
+    assert sum(s._radix.hit_tokens for s in dec) >= aligned
+    # both the source read and the destination write counted their shards
+    assert cs1["ok"] - cs0["ok"] >= 2 * len(reqs)
+    assert cs1["error"] == cs0["error"]
+    for s in srv.servers:
+        s._alloc.check()
+        s._radix.check()
+    srv.close()
+
+
+def test_cp_shard_stream_transient_retry_then_ok(setup):
+    """A transient ``cp_shard_stream`` fault (one shard hiccups once)
+    defers the hand-off one sweep — outcome=retried then ok, token
+    identity preserved, the shard-stream error counter incremented."""
+    params, _ = setup
+    plan = FaultPlan.transient_at("cp_shard_stream", 0, key=1)
+    srv = make_dsrv(params, fault_plan=plan)
+    b, cs0 = handoff_tally(), stream_tally()
+    p = prompt(73, 2 * BS + 3)
+    r = srv.submit(p, 6)
+    srv.run_until_idle()
+    a, cs1 = handoff_tally(), stream_tally()
+    assert r.error is None
+    assert r.tokens == oracle(params, p, 6)
+    assert a["retried"] - b["retried"] == 1, (b, a)
+    assert a["ok"] - b["ok"] == 1
+    assert cs1["error"] - cs0["error"] == 1
+    for s in srv.servers:
+        s._alloc.check()
+    srv.close()
+
+
+def test_cp_shard_stream_permanent_falls_back(setup):
+    """A permanent ``cp_shard_stream`` fault (one shard cannot serve its
+    slice) exhausts the retry budget and falls back: the request keeps
+    decoding on its prefill replica, token-identically — never a
+    half-streamed prefix."""
+    params, _ = setup
+    plan = FaultPlan.permanent("cp_shard_stream", key=0)
+    srv = make_dsrv(params, fault_plan=plan)
+    b = handoff_tally()
+    p = prompt(74, 2 * BS + 3)
+    r = srv.submit(p, 6)
+    srv.run_until_idle()
+    a = handoff_tally()
+    assert r.error is None
+    assert r.tokens == oracle(params, p, 6)
+    assert a["fallback"] - b["fallback"] == 1, (b, a)
+    assert a["ok"] - b["ok"] == 0
+    pre = [s for s in srv.servers if srv.role_of(s) == "prefill"]
+    assert sum(s.counters.requests_completed for s in pre) == 1
+    srv.close()
+
+
+# ------------------------------------------------------------- host tier
+
+
+def test_cp_host_tier_demote_restore_byte_exact_per_shard(setup):
+    """The host radix tier at cp=2: demoted nodes read their blocks from
+    the owner shards (bytes compared per shard against a direct arena
+    read), carry the shard-tagged component layout, and a later radix
+    re-hit restores them to device and decodes token-identically."""
+    params, eng = setup
+    srv = serve(eng, cp=2, prefix_cache="host", host_pool_blocks=64)
+    shared = prompt(81, 6 * BS)  # long enough to stripe over both shards
+    p1 = np.concatenate([shared, prompt(82, 9)])
+    r1 = srv.submit(p1, max_new_tokens=6)
+    drive(srv, [r1])
+    assert r1.tokens == oracle(params, p1, 6)
+
+    # capture every cold node's arena bytes (and owner shards) pre-demote
+    cold = [
+        n for n in srv._radix._iter_nodes()
+        if n.on_device() and n.refs == 0
+    ]
+    assert cold
+    pre = {
+        id(n): (
+            [srv._alloc.owner(b) for b in n.blocks],
+            tuple(np.asarray(a) for a in srv._read_arena_blocks(n.blocks)),
+        )
+        for n in cold
+    }
+    assert any(len(set(ow)) == 2 for ow, _ in pre.values()), (
+        "test prompt did not stripe its radix nodes over both shards"
+    )
+    moved = srv._radix.demote_all()
+    assert moved > 0
+    host_nodes = [
+        n for n in srv._radix._iter_nodes() if not n.on_device()
+    ]
+    assert host_nodes
+    for n in host_nodes:
+        owners, bytes_ = pre[id(n)]
+        # the shard-tagged layout records demote-time ownership
+        assert n.host_owners == owners
+        for sh in sorted(set(owners)):
+            # per-shard byte comparison: the demoted copy's blocks owned
+            # by shard sh must equal the pre-demote arena read's
+            sel = [i for i, o in enumerate(owners) if o == sh]
+            for comp, host_comp in zip(bytes_, n.host_kv):
+                np.testing.assert_array_equal(
+                    comp[:, :, sel], np.asarray(host_comp)[:, :, sel],
+                    err_msg=f"shard {sh} bytes diverged through demote",
+                )
+    hh0 = srv._radix.host_hit_tokens
+    p2 = np.concatenate([shared, prompt(83, 12)])
+    r2 = srv.submit(p2, max_new_tokens=6)
+    drive(srv, [r2])
+    assert r2.tokens == oracle(params, p2, 6)
+    assert srv._radix.host_hit_tokens > hh0, "restore path never exercised"
+    srv._radix.check()
+    srv._alloc.check()
+    srv.close()
+
+
+# --------------------------------------------------------- the gate audit
+
+
+def test_retired_cp_gates_are_deleted_not_bypassed():
+    """The cp>1 typed gates ISSUE 19 retired must be GONE from the
+    snapshot/extract/adopt/arena-rw paths — no ``raise
+    NotImplementedError`` anywhere in those bodies (an ``if cp > 1:
+    pass``-style bypass would fail this too: the audit is on the raise
+    statement, not the message)."""
+    retired = [
+        PipelineServer.snapshot,
+        PipelineServer.extract,
+        PipelineServer.adopt,
+        PipelineServer._read_arena_blocks_dispatch,
+        PipelineServer._write_arena_blocks,
+        PipelineServer._cp_stream_check,
+    ]
+    for fn in retired:
+        tree = ast.parse(textwrap.dedent(inspect.getsource(fn)))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Raise) or node.exc is None:
+                continue
+            exc = node.exc
+            name = ""
+            if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name):
+                name = exc.func.id
+            elif isinstance(exc, ast.Name):
+                name = exc.id
+            assert name != "NotImplementedError", (
+                f"{fn.__qualname__} still raises NotImplementedError — "
+                "retired cp gates must be deleted, not bypassed"
+            )
+
+
+def test_remaining_cp_gates_keep_curated_wording(setup):
+    """The gates that legitimately remain (cp×tp, cp speculation) keep
+    their curated messages — wording pinned so a refactor cannot silently
+    degrade them into bare errors."""
+    import llm_sharding_tpu.runtime.server as server_mod
+
+    src = inspect.getsource(server_mod)
+    assert "cp × tp serving" in src
+    assert "cp-aware speculation" in src
+    # and the speculation gate really fires, typed, with that wording
+    _, eng = setup
+    with pytest.raises(NotImplementedError, match="cp-aware speculation"):
+        serve(eng, cp=2, prefill_chunk=None, speculate=2)
+
+
+def test_cp_stream_metric_registered():
+    """shardlint metrics-discipline: the per-shard stream counter is
+    registered (and README-documented — the lint test cross-checks)."""
+    fam = REGISTRY.get("server_cp_stream_shards_total")
+    assert fam is not None
+    assert fam.labels(outcome="ok").value >= 0.0

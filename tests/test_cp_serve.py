@@ -239,6 +239,10 @@ def test_cp2_admits_prompt_exceeding_one_shard_arena(setup):
 
 
 def test_cp_unsupported_combinations_are_typed(setup):
+    """The gates that legitimately REMAIN after ISSUE 19 retired the
+    resilience ones (snapshot/extract/adopt/arena-rw/host-tier now work
+    sharded — ``tests/test_cp_resilience.py``): dense+cp, cp×speculate and
+    the one-shot prefix-handle path keep curated messages."""
     params, eng = setup
     with pytest.raises(ValueError, match="paged"):
         eng.serve(capacity=CAP, cp=2)  # dense + cp
@@ -247,6 +251,4 @@ def test_cp_unsupported_combinations_are_typed(setup):
     srv = serve(eng, cp=2)
     with pytest.raises(NotImplementedError, match="prefill_prefix"):
         srv.prefill_prefix(prompt(71, 2 * BS))
-    with pytest.raises(NotImplementedError, match="snapshot"):
-        srv.snapshot()
     srv.close()
