@@ -1408,6 +1408,195 @@ def bench_cp_failover_serve(on_tpu, cfg, params, jax, jnp):
     )
 
 
+def bench_global_radix_serve(on_tpu, cfg, params, jax, jnp):
+    """ISSUE 20 headline: cluster-global cache-aware routing over the
+    three-tier KV ladder. A dp2 fleet serves a chat workload whose shared
+    prefixes total ~10x ONE replica's arena (so the working set only survives
+    across the hbm → pinned-host → mmap-disk demotion ladder), round 2
+    re-sends every conversation in a shuffled order, and the headline is
+    warm-fleet TTFT p50 with the cluster index steering each request to
+    the replica that PUBLISHED its prefix, vs the ``global_index=False``
+    baseline (pure least-loaded: no index, no probing — a re-sent chat
+    lands on the cold replica whenever round-robin says so and re-prefills
+    its whole history). Both gates are in-band RuntimeErrors: the warm
+    rounds must be token-identical to the cold round (greedy exactness
+    through every tier), and a final round served entirely through
+    disk→host→arena promotion (``demote_all(to_disk=True)`` between
+    rounds) must match the never-demoted outputs token-for-token."""
+    import shutil
+    import tempfile
+
+    from llm_sharding_tpu.obs.metrics import PREFIX_HIT_TOKENS
+    from llm_sharding_tpu.runtime.replicated import ReplicatedServer
+
+    name = (
+        "serve_global_radix_ttft_llama3.2-3b_dp2" if on_tpu
+        else "serve_global_radix_ttft_tiny_cpu"
+    )
+    extra_kw = {}
+    if on_tpu:
+        stages, bs, cap = 1, 64, 768
+        kv_blocks = 20 + 1                   # one replica's arena (+trash)
+        prefix_blocks, suffix_len, max_new = 7, 16, 32
+    else:
+        # own tiny engine (the bench_cp_serve precedent): the shared CPU
+        # smoke config tops out at 128 positions and 2 layers, where a
+        # re-prefill costs about the same as a promotion stream — the
+        # routing signal needs chats long enough that recomputing one is
+        # visibly dearer than streaming its KV back up the ladder
+        from llm_sharding_tpu.models import llama as _llama
+        from llm_sharding_tpu.models.config import tiny_llama as _tiny
+
+        cfg = _tiny(num_hidden_layers=4, max_position_embeddings=1024)
+        params = _llama.init_params(
+            cfg, jax.random.key(29), dtype=jnp.float32
+        )
+        extra_kw["cache_dtype"] = jnp.float32
+        stages, bs, cap = 2, 16, 768
+        kv_blocks = 40 + 1
+        prefix_blocks, suffix_len, max_new = 28, 4, 8
+    n_dev = len(jax.devices())
+    if n_dev < 2 * stages:
+        emit_error(name, "ms",
+                   f"needs >= {2 * stages} devices for dp2 x {stages} "
+                   f"stage(s) (have {n_dev})")
+        return
+    devices = jax.devices()[: 2 * stages]
+    arena_tokens = (kv_blocks - 1) * bs
+    prefix_len = prefix_blocks * bs
+    # the chat working set: enough distinct shared prefixes that their
+    # token total is ~10x what one replica's arena can hold resident
+    n_prefix = max(4, (10 * arena_tokens) // prefix_len)
+    host_blocks = 3 * (kv_blocks - 1)        # pinned-host rung: ~3x arena
+    disk_blocks = 16 * (kv_blocks - 1)       # disk rung holds the rest
+    rng = np.random.default_rng(23)
+    prompts = [
+        np.concatenate([
+            rng.integers(0, cfg.vocab_size, prefix_len).astype(np.int32),
+            rng.integers(0, cfg.vocab_size, suffix_len).astype(np.int32),
+        ])
+        for _ in range(n_prefix)
+    ]
+    # round 2/3 re-send every conversation in a fixed shuffled order —
+    # with the index OFF the round-robin pick realigns with the cold
+    # round's placement for ~half of them only
+    order = rng.permutation(n_prefix)
+
+    def hit_tally():
+        return sum(
+            PREFIX_HIT_TOKENS.labels(tier=t).value
+            for t in ("hbm", "host", "disk")
+        )
+
+    def run(index_on, n=None, promote_round=True):
+        pool = tempfile.mkdtemp(prefix="bench_gindex_")
+        ps = prompts[:n] if n else prompts
+        od = [i for i in order if i < len(ps)]
+        srv = ReplicatedServer(
+            cfg, params, data_parallel=2, num_stages=stages,
+            devices=devices, capacity=cap, kv_block_size=bs,
+            kv_blocks=kv_blocks, prefix_cache="disk",
+            host_pool_blocks=host_blocks, disk_pool_dir=pool,
+            disk_pool_blocks=disk_blocks,
+            global_index=(None if index_on else False),
+            **extra_kw,
+        )
+        try:
+            def round_(idx, sequential=False):
+                # measured rounds run one conversation at a time: TTFT
+                # then reads routed-hit-vs-re-prefill latency, not the
+                # queue depth of a batch dump
+                reqs = []
+                if sequential:
+                    for i in idx:
+                        reqs.append(
+                            srv.submit(ps[i], max_new_tokens=max_new)
+                        )
+                        srv.run_until_idle()
+                else:
+                    reqs = [srv.submit(ps[i], max_new_tokens=max_new)
+                            for i in idx]
+                    srv.run_until_idle()
+                assert all(r.error is None for r in reqs), [
+                    (r.id, r.error) for r in reqs if r.error is not None
+                ]
+                toks = {}
+                ttft = []
+                for i, r in zip(idx, reqs):
+                    toks[i] = list(r.tokens)
+                    ttft.append(r.first_token_at - r.submitted_at)
+                return toks, np.asarray(ttft)
+
+            cold_toks, _ = round_(range(len(ps)))
+            h0 = hit_tally()
+            warm_toks, warm_ttft = round_(od, sequential=True)
+            saved = int(hit_tally() - h0)
+            if warm_toks != cold_toks:
+                raise RuntimeError(
+                    "warm-fleet round diverged from the cold round "
+                    "(greedy identity through the tier ladder broke)"
+                )
+            disk_toks = None
+            if promote_round:
+                # push EVERYTHING to the mmap tier, then serve the same
+                # conversations through disk→host→arena promotion
+                d0 = sum(
+                    s._radix.disk_hit_tokens for s in srv.servers
+                )
+                for s in srv.servers:
+                    with s._mutex:
+                        s._radix.demote_all(to_disk=True)
+                disk_toks, _ = round_(od)
+                disk_hits = sum(
+                    s._radix.disk_hit_tokens for s in srv.servers
+                ) - d0
+                if disk_toks != cold_toks:
+                    raise RuntimeError(
+                        "disk-promoted round diverged from the "
+                        "never-demoted outputs"
+                    )
+                if disk_hits <= 0:
+                    raise RuntimeError(
+                        "promotion round streamed no disk-tier tokens — "
+                        "the ladder fell back to re-prefill"
+                    )
+            return warm_ttft, saved
+        finally:
+            srv.close()
+            del srv
+            gc.collect()
+            shutil.rmtree(pool, ignore_errors=True)
+
+    # compile prelude: cold admission, warm suffix admission and the
+    # promotion path on a 4-conversation fleet (programs are shared by
+    # both measured runs — the jit cache is process-wide)
+    run(True, n=4)
+    base_ttft, base_saved = run(False, promote_round=False)
+    warm_ttft, saved = run(True)
+    warm_p50 = float(np.percentile(warm_ttft, 50)) * 1e3
+    base_p50 = float(np.percentile(base_ttft, 50)) * 1e3
+    if warm_p50 >= base_p50:
+        raise RuntimeError(
+            f"cluster-index warm TTFT p50 ({warm_p50:.1f} ms) is not "
+            f"below the index-off baseline ({base_p50:.1f} ms) — "
+            "cache-aware routing bought nothing"
+        )
+    emit(
+        name, warm_p50, "ms", base_p50 / max(warm_p50, 1e-9),
+        baseline_ttft_p50_ms=round(base_p50, 2),
+        ttft_p99_ms=round(float(np.percentile(warm_ttft, 99)) * 1e3, 2),
+        baseline_ttft_p99_ms=round(
+            float(np.percentile(base_ttft, 99)) * 1e3, 2
+        ),
+        prefill_tokens_saved=saved,
+        baseline_prefill_tokens_saved=base_saved,
+        conversations=n_prefix,
+        working_set_tokens=n_prefix * prefix_len,
+        arena_tokens_per_replica=arena_tokens,
+        token_identical=True,
+    )
+
+
 def bench_disagg_serve(on_tpu, cfg, params, jax, jnp):
     """Disaggregated prefill/decode serving (runtime/disagg.py) vs unified
     dp2 on a MIXED workload: interactive short-prompt streams decoding
@@ -2611,6 +2800,10 @@ def main():
         "serve_cp_failover_tok_s_llama3.2-3b_dp2" if on_tpu
         else "serve_cp_failover_tok_s_tiny_cpu"
     )
+    nglobal = (
+        "serve_global_radix_ttft_llama3.2-3b_dp2" if on_tpu
+        else "serve_global_radix_ttft_tiny_cpu"
+    )
 
     # section order = survival priority under a driver-side timeout:
     # 3B (anchor emitted immediately) → serve → 3B-int8 → pallas → 7B(+int8)
@@ -2845,6 +3038,18 @@ def main():
                 bench_disagg_serve(on_tpu, cfg3b, params3b, jax, jnp)
             except Exception as e:  # noqa: BLE001
                 emit_error(ndisagg, "ms", e)
+            gc.collect()
+        # cluster-global radix routing (ISSUE 20: warm-fleet TTFT with the
+        # index steering re-sent chats to their holder replica across the
+        # three-tier KV ladder, vs the load-only baseline) builds its own
+        # replica engines from params3b too — also before int8 donates
+        if remaining() < 240:
+            emit_skip(nglobal, "ms", 240)
+        else:
+            try:
+                bench_global_radix_serve(on_tpu, cfg3b, params3b, jax, jnp)
+            except Exception as e:  # noqa: BLE001
+                emit_error(nglobal, "ms", e)
             gc.collect()
         del serve_engine
         gc.collect()

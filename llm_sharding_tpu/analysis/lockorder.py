@@ -71,6 +71,7 @@ ORDER: Tuple[str, ...] = (
     "server.scheduler",       # async-exec scheduler kick/delta condition
     "server.exec_sidecar",    # async-exec completion-sidecar wake condition
     "disagg.handoff",         # sidecar rendezvous condition (counters only)
+    "cluster.index",          # global radix index map (publish/lookup)
     "engine.reconfig",        # PipelineEngine._lock: placement swap vs use
     "faults.plan",            # FaultPlan arming/matching
     "fairness.queue",         # FairQueue state (tenant heaps, service)

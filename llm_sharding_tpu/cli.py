@@ -218,6 +218,10 @@ def _serve_control(eng, srv, line: str, args):
         if pc is not None:
             # hit rate + tier occupancy for the operator tuning the cache
             stats["prefix_cache"] = pc
+        gx = getattr(srv, "_gindex", None)
+        if gx is not None:
+            # the cluster-global radix index's routing view (dp >= 2)
+            stats["global_index"] = gx.stats()
         print(json.dumps(stats, sort_keys=True), file=sys.stderr)
         return srv
     if cmd == ":profile":
@@ -297,8 +301,11 @@ def _serve_control(eng, srv, line: str, args):
                 paged_attn=srv.paged_attn,
                 prefix_cache=srv.prefix_cache,
                 host_pool_blocks=(
-                    srv.host_pool_blocks if srv.prefix_cache == "host" else 0
+                    srv.host_pool_blocks
+                    if srv.prefix_cache in ("host", "disk") else 0
                 ),
+                disk_pool_dir=srv.disk_pool_dir,
+                disk_pool_blocks=srv.disk_pool_blocks,
                 gauge_sweep_every_s=srv.gauge_sweep_every_s,
                 cp=srv.cp,
             )
@@ -486,10 +493,30 @@ def cmd_serve(args) -> int:
         return 2
     if getattr(args, "host_pool_blocks", 0) and getattr(
         args, "prefix_cache", "off"
-    ) != "host":
+    ) not in ("host", "disk"):
         print(
             "error: --host-pool-blocks sizes the host-RAM tier — it needs "
-            f"--prefix-cache host (got --prefix-cache "
+            f"--prefix-cache host or disk (got --prefix-cache "
+            f"{getattr(args, 'prefix_cache', 'off')})",
+            file=sys.stderr,
+        )
+        return 2
+    if getattr(args, "prefix_cache", "off") == "disk" and not getattr(
+        args, "disk_pool_dir", None
+    ):
+        print(
+            "error: --prefix-cache disk needs --disk-pool-dir (the on-disk "
+            "KV pool is the persistent artifact — it must have a home)",
+            file=sys.stderr,
+        )
+        return 2
+    if (
+        getattr(args, "disk_pool_dir", None)
+        or getattr(args, "disk_pool_blocks", 0)
+    ) and getattr(args, "prefix_cache", "off") != "disk":
+        print(
+            "error: --disk-pool-dir/--disk-pool-blocks configure the disk "
+            "KV tier — they need --prefix-cache disk (got --prefix-cache "
             f"{getattr(args, 'prefix_cache', 'off')})",
             file=sys.stderr,
         )
@@ -564,7 +591,7 @@ def cmd_serve(args) -> int:
             return 2
         if getattr(args, "prefix_cache", "off") == "off":
             print(
-                "error: --disagg needs --prefix-cache hbm or host: the "
+                "error: --disagg needs --prefix-cache hbm, host or disk: the "
                 "hand-off lands streamed KV in the decode replica's radix "
                 "tree so adoption skips re-prefill",
                 file=sys.stderr,
@@ -693,6 +720,8 @@ def cmd_serve(args) -> int:
             paged_attn=getattr(args, "paged_attn", "auto"),
             prefix_cache=getattr(args, "prefix_cache", "off"),
             host_pool_blocks=getattr(args, "host_pool_blocks", 0),
+            disk_pool_dir=getattr(args, "disk_pool_dir", None),
+            disk_pool_blocks=getattr(args, "disk_pool_blocks", 0),
             gauge_sweep_every_s=getattr(args, "gauge_sweep_every", 0.0),
             min_replicas=getattr(args, "min_replicas", 1),
             # context-parallel replicas: each replica's paged arena is
@@ -781,6 +810,12 @@ def cmd_serve(args) -> int:
                     ("host_pool_blocks",
                      getattr(args, "host_pool_blocks", 0) or None,
                      srv.host_pool_blocks or None),
+                    ("disk_pool_dir",
+                     getattr(args, "disk_pool_dir", None),
+                     srv.disk_pool_dir),
+                    ("disk_pool_blocks",
+                     getattr(args, "disk_pool_blocks", 0) or None,
+                     srv.disk_pool_blocks or None),
                     ("cp", getattr(args, "cp", 1), srv.cp),
                 )
                 if got != used
@@ -821,6 +856,8 @@ def cmd_serve(args) -> int:
                 paged_attn=getattr(args, "paged_attn", "auto"),
                 prefix_cache=getattr(args, "prefix_cache", "off"),
                 host_pool_blocks=getattr(args, "host_pool_blocks", 0),
+                disk_pool_dir=getattr(args, "disk_pool_dir", None),
+                disk_pool_blocks=getattr(args, "disk_pool_blocks", 0),
                 gauge_sweep_every_s=getattr(args, "gauge_sweep_every", 0.0),
                 cp=getattr(args, "cp", 1),
             )
@@ -1594,8 +1631,8 @@ def build_parser() -> argparse.ArgumentParser:
         "window through HBM",
     )
     s.add_argument(
-        "--prefix-cache", choices=("off", "hbm", "host"), default="off",
-        dest="prefix_cache",
+        "--prefix-cache", choices=("off", "hbm", "host", "disk"),
+        default="off", dest="prefix_cache",
         help="automatic prefix caching (with --kv-block-size/--kv-blocks): "
         "a radix tree over token ids indexes every finished request's "
         "prompt blocks, and every new request transparently reuses its "
@@ -1605,14 +1642,30 @@ def build_parser() -> argparse.ArgumentParser:
         "in the device arena and cold entries drop under pressure; host = "
         "cold entries first demote to a pinned host-RAM pool and stream "
         "back on a later hit, so HBM becomes a cache level instead of a "
-        "hard ceiling. Explicit prefill_prefix handles remain the "
-        "manual/pinned escape hatch",
+        "hard ceiling; disk = cold HOST entries further demote to "
+        "memory-mapped files under --disk-pool-dir, survive restarts, and "
+        "promote disk -> host -> arena on a hit. Explicit prefill_prefix "
+        "handles remain the manual/pinned escape hatch",
     )
     s.add_argument(
         "--host-pool-blocks", type=int, default=0, dest="host_pool_blocks",
-        help="host-RAM tier size in KV blocks for --prefix-cache host "
+        help="host-RAM tier size in KV blocks for --prefix-cache host/disk "
         "(0 = default to --kv-blocks, an arena-sized pool); host RAM cost "
         "is pool x the per-block KV bytes",
+    )
+    s.add_argument(
+        "--disk-pool-dir", default=None, dest="disk_pool_dir",
+        help="directory for the --prefix-cache disk KV pool (required with "
+        "disk mode); the pool is the persistent artifact — a restarted "
+        "daemon re-adopts its entries cold, and snapshots reference them "
+        "instead of inlining the KV bytes. With --data-parallel each "
+        "replica pools under DIR/r<i>",
+    )
+    s.add_argument(
+        "--disk-pool-blocks", type=int, default=0, dest="disk_pool_blocks",
+        help="disk tier size in KV blocks for --prefix-cache disk (0 = "
+        "default to --kv-blocks); disk cost is pool x the per-block KV "
+        "bytes, per replica",
     )
     s.add_argument(
         "--snapshot-every", type=float, default=0.0, dest="snapshot_every",

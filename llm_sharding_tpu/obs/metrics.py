@@ -530,8 +530,12 @@ KV_WASTE_FRAC = REGISTRY.gauge(
 PREFIX_HIT_TOKENS = REGISTRY.counter(
     "server_prefix_cache_hit_tokens_total",
     "Prompt tokens served from the radix prefix cache instead of being "
-    "prefilled (summed over admissions on live servers); the saved "
-    "prefill FLOPs scale with this",
+    "prefilled (summed over admissions on live servers), by the tier the "
+    "tokens lived in when the match was taken: hbm = already arena-"
+    "resident, host = streamed back from the pinned host pool, disk = "
+    "promoted from the memory-mapped disk pool. The saved prefill FLOPs "
+    "scale with the sum",
+    labels=("tier",),
 )
 PREFIX_HIT_RATE = REGISTRY.gauge(
     "server_prefix_cache_hit_rate",
@@ -544,6 +548,20 @@ KV_HOST_TIER_BLOCKS = REGISTRY.gauge(
     "server_kv_host_tier_blocks",
     "Prefix-cache blocks currently demoted to the pinned host-RAM pool "
     "across live servers (streamed back to HBM on a later radix hit)",
+)
+KV_DISK_TIER_BLOCKS = REGISTRY.gauge(
+    "server_kv_disk_tier_blocks",
+    "Prefix-cache blocks currently spilled to the bounded on-disk pool "
+    "across live servers (memory-mapped entry files; promoted "
+    "disk→host→arena on a later radix hit, and the pool survives "
+    "restarts)",
+)
+GLOBAL_INDEX_ENTRIES = REGISTRY.gauge(
+    "server_global_index_entries",
+    "Live {prefix-hash, replica} entries in the cluster-global radix "
+    "index — the map replicas publish their tree contents into and the "
+    "fleet router consults before placing a request (deepest match "
+    "first, then warmest tier)",
 )
 
 #: Decode-attention implementations a live server can run

@@ -286,9 +286,19 @@ class DisaggServer(ReplicatedServer):
         # EARLIEST index on ties, so the healthiest least-loaded replica
         # wins equal predictions
         cands.sort(key=lambda s: (_HEALTH_SEVERITY[s.health], self._load(s)))
+        # cached-token inputs come from ONE cluster-index lookup when the
+        # index is live (no per-candidate tree probe under its mutex);
+        # the index is a hint — a stale depth only skews the TTFT
+        # prediction, admission re-matches against the real tree
+        if self._gindex is not None:
+            keys = {s: f"g{self._group_of[s]}" for s in cands}
+            scored = self._gindex.scores(prompt, keys.values())
+            cached = {s: scored[keys[s]][0] for s in cands}
+        else:
+            cached = {s: s.radix_match_tokens(prompt) for s in cands}
         descr = [
             dict(
-                cached_tokens=s.radix_match_tokens(prompt),
+                cached_tokens=cached[s],
                 backlog_tokens=sum(r.prompt_len for r in s._queue),
                 inflight_rows=sum(
                     r is not None and not r.done for r in s._rows
@@ -872,17 +882,32 @@ class DisaggServer(ReplicatedServer):
         """Cross-replica radix fill for ordinary traffic: when the routed
         replica's match is at least one block colder than the warmest
         other replica's, stream the difference instead of re-prefilling
-        it. Best-effort — any failure just means a cold prefill."""
+        it. With the cluster index live, the warmest peer comes from ONE
+        index lookup (deepest match, warmest tier) and only THAT peer's
+        tree is probed to confirm — per-peer probing remains the fallback
+        while the index is unbuilt. Best-effort — any failure (including
+        a stale index entry) just means a cold prefill."""
         if dst._radix is None:
             return 0
         have = dst.radix_match_tokens(prompt)
         best, bn = None, have
-        for s in self.servers:
-            if s is dst or s._closed:
-                continue
-            m = s.radix_match_tokens(prompt)
-            if m > bn:
-                best, bn = s, m
+        if self._gindex is not None:
+            dst_key = f"g{self._group_of[dst]}"
+            hit = self._gindex.best(prompt, exclude=(dst_key,))
+            if hit is not None:
+                src = self._by_group.get(int(hit[0][1:]))
+                if src is not None and src is not dst and not src._closed:
+                    # the peer's real tree governs what actually streams
+                    m = src.radix_match_tokens(prompt)
+                    if m > bn:
+                        best, bn = src, m
+        else:
+            for s in self.servers:
+                if s is dst or s._closed:
+                    continue
+                m = s.radix_match_tokens(prompt)
+                if m > bn:
+                    best, bn = s, m
         if best is None or bn - have < (dst.kv_block_size or 1):
             return 0
         try:

@@ -514,6 +514,8 @@ class PipelineEngine:
         paged_attn: str = "auto",
         prefix_cache: str = "off",
         host_pool_blocks: int = 0,
+        disk_pool_dir: Optional[str] = None,
+        disk_pool_blocks: int = 0,
         gauge_sweep_every_s: float = 0.0,
         cp: int = 1,
     ):
@@ -549,7 +551,11 @@ class PipelineEngine:
         reuses the longest cached prompt prefix, finished rows' prompt
         blocks are indexed instead of freed, and — with ``"host"`` — cold
         blocks demote to a pinned host-RAM pool of ``host_pool_blocks``
-        (default: arena-sized) before being dropped.
+        (default: arena-sized) before being dropped. ``"disk"`` extends
+        the ladder one tier further: cold HOST blocks demote to
+        memory-mapped files under ``disk_pool_dir`` (bounded by
+        ``disk_pool_blocks``, default arena-sized), survive restarts, and
+        promote disk → host → arena on a hit.
 
         Resilience knobs (see ``runtime/server.py``'s module docstring):
         ``max_queue=`` bounds the submit queue (``QueueFull`` past it),
@@ -619,6 +625,8 @@ class PipelineEngine:
             paged_attn=paged_attn,
             prefix_cache=prefix_cache,
             host_pool_blocks=host_pool_blocks,
+            disk_pool_dir=disk_pool_dir,
+            disk_pool_blocks=disk_pool_blocks,
             gauge_sweep_every_s=gauge_sweep_every_s,
             cp=cp,
         )

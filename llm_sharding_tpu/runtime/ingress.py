@@ -621,6 +621,19 @@ class IngressServer:
                             "id": server.model_name, "object": "model",
                         }],
                     })
+                elif path == "/indexz":
+                    # the cluster-global radix index's routing view (how
+                    # much of the fleet's trees it mirrors); 404 when the
+                    # backend has no index (single replica / cache off /
+                    # global_index=False)
+                    gx = getattr(server.backend, "_gindex", None)
+                    if gx is None:
+                        self._error(
+                            404, "no_index",
+                            "backend has no cluster-global radix index",
+                        )
+                    else:
+                        self._json(200, gx.stats())
                 else:
                     self._error(404, "not_found", "try POST /v1/completions")
 

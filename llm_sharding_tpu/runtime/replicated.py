@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import collections
 import logging
+import os
 import threading
 import time
 import weakref
@@ -121,6 +122,7 @@ class ReplicatedServer:
         failure_threshold: int = 3,
         failure_window_s: float = 60.0,
         min_replicas: int = 1,
+        global_index: Optional[bool] = None,
         **serve_kwargs,
     ):
         import jax.numpy as jnp
@@ -167,6 +169,11 @@ class ReplicatedServer:
         # auto-snapshots likewise: one directory per replica, or D daemons
         # would race the same atomic rename
         self._snapshot_path = serve_kwargs.pop("snapshot_path", None)
+        # disk KV pools likewise: one subdirectory per DEVICE GROUP, or D
+        # replicas would collide on the monotonically numbered e<N> entry
+        # files. Keyed by the stable group index, so a replica re-spawned
+        # on group d (drain/spawn, failover) ADOPTS its predecessor's pool.
+        self._disk_pool_dir = serve_kwargs.pop("disk_pool_dir", None)
         self._cfg = cfg
         self._num_stages = num_stages
         self._tp = tensor_parallel
@@ -203,6 +210,15 @@ class ReplicatedServer:
         self._rhandles: "weakref.WeakSet[ReplicatedPrefixHandle]" = (
             weakref.WeakSet()
         )
+        # cluster-global radix index: replicas with a prefix cache publish
+        # their tree contents (insert/demote/promote/evict) into one
+        # token-hash → {replica, tier} map and _pick consults IT instead
+        # of probing every replica's tree under its mutex. None (auto) =
+        # on whenever any replica caches; False = disable cluster
+        # cache-aware routing entirely (index AND per-replica probing) —
+        # the A/B baseline the bench compares against.
+        self._gindex_opt = global_index
+        self._gindex = None
         for d in range(data_parallel):
             self._spawn_on_group(d)
         self._rr = 0
@@ -234,10 +250,15 @@ class ReplicatedServer:
             snapshot_path=(
                 f"{self._snapshot_path}.r{d}" if self._snapshot_path else None
             ),
+            disk_pool_dir=(
+                os.path.join(self._disk_pool_dir, f"r{d}")
+                if self._disk_pool_dir else None
+            ),
             **self._serve_kwargs,
         )
         srv._span_src = f"r{d}"  # flight-recorder spans name their replica
         srv.stepline.name = f"r{d}"  # /debugz step rings likewise
+        self._wire_index(srv, d)
         self.engines.append(eng)
         self.servers.append(srv)
         self._by_group[d] = srv
@@ -247,11 +268,37 @@ class ReplicatedServer:
         self._set_replica_gauge(d, srv.health)
         return srv
 
+    def _wire_index(self, srv: PipelineServer, d: int) -> None:
+        """Attach a caching replica to the cluster index: build the index
+        on first need (the replica's resolved block size defines the hash
+        granularity), wire the tree's publish hook under the replica's
+        stable group key, and announce any pre-existing contents (snapshot
+        restore, adopted disk pool)."""
+        if self._gindex_opt is False or getattr(srv, "_radix", None) is None:
+            return
+        if self._gindex is None:
+            from .global_index import GlobalRadixIndex
+
+            self._gindex = GlobalRadixIndex(srv.kv_block_size)
+        key, gindex = f"g{d}", self._gindex
+        srv._radix.publish = (
+            lambda ids, tier, _k=key, _ix=gindex: _ix.publish(_k, ids, tier)
+        )
+        srv._radix.announce_all()
+
     def _retire(self, srv: PipelineServer) -> int:
         """Remove a replica from routing, stepping and supervision (it
         receives no new admissions and its group is spawnable again once
         the caller closes it). Returns the freed group index."""
         d = self._group_of.pop(srv)
+        if self._gindex is not None:
+            # the fleet must stop routing toward a dead tree NOW; the
+            # retiring server itself stops publishing (its late releases
+            # during migration would otherwise re-insert entries)
+            rad = getattr(srv, "_radix", None)
+            if rad is not None:
+                rad.publish = None
+            self._gindex.drop_replica(f"g{d}")
         self._by_group.pop(d, None)
         i = self.servers.index(srv)
         del self.servers[i]
@@ -287,14 +334,17 @@ class ReplicatedServer:
         new traffic while at least one exists (a DEGRADED replica must not
         win least-loaded ties — it is the one most likely to fail the
         request); when none are SERVING, fall back in severity order to the
-        least-bad class. With per-replica prefix caches and a prompt, the
-        WARMEST replicas win first — each replica's radix tree is local,
-        so a request routed to the one holding its longest cached prefix
-        skips that much prefill (ties, and cold prompts, fall through to
-        load). Least-loaded (queued + in-flight) within the class;
-        round-robin ties. ``covered`` restricts candidates (prefix
-        routing). Raises ``ServerClosed`` when no replica can take the
-        request."""
+        least-bad class. With prefix caches and a prompt, the WARMEST
+        replicas win first: one cluster-index lookup scores every
+        candidate by (match depth, tier warmth) — deepest cached prefix
+        first, hbm > host > disk on depth ties — so a request lands where
+        it skips the most prefill at the cheapest promotion cost, without
+        probing N replica trees under their mutexes (the pre-index probe
+        remains only as a fallback while the index is unbuilt; ties, and
+        cold prompts, fall through to load). Least-loaded (queued +
+        in-flight) within the class; round-robin ties. ``covered``
+        restricts candidates (prefix routing). Raises ``ServerClosed``
+        when no replica can take the request."""
         with self._lock:
             cands = [
                 s for s in self.servers
@@ -315,8 +365,17 @@ class ReplicatedServer:
                 serving = [
                     s for s in cands if _HEALTH_SEVERITY[s.health] == best
                 ]
-            if prompt_ids is not None and any(
-                s._radix is not None for s in serving
+            if prompt_ids is not None and self._gindex is not None:
+                keys = {s: f"g{self._group_of[s]}" for s in serving}
+                scored = self._gindex.scores(prompt_ids, keys.values())
+                best = max(scored[keys[s]] for s in serving)
+                if best > (0, 0):
+                    serving = [
+                        s for s in serving if scored[keys[s]] == best
+                    ]
+            elif (
+                prompt_ids is not None and self._gindex_opt is not False
+                and any(s._radix is not None for s in serving)
             ):
                 matches = {
                     s: s.radix_match_tokens(prompt_ids) for s in serving
@@ -779,7 +838,16 @@ class ReplicatedServer:
             rsrv._failures[s] = collections.deque()
             rsrv._seen_contained[s] = s.containment_events
             rsrv._set_replica_gauge(d, s.health)
+        if rsrv._gindex is not None:
+            # the template servers' (empty) publications go; the restored
+            # trees re-announce under the same group keys
+            for d in rsrv._by_group:
+                rsrv._gindex.drop_replica(f"g{d}")
+        for d, s in enumerate(restored):
+            rsrv._wire_index(s, d)
         for s in old:
+            if getattr(s, "_radix", None) is not None:
+                s._radix.publish = None  # no late entries under a live key
             try:
                 s.close()
             except Exception:  # noqa: BLE001 — best-effort teardown
@@ -886,7 +954,7 @@ class ReplicatedServer:
                     # replica is warm
                     entry["prefix_cache"] = pc
                 replicas.append(entry)
-            return {
+            out = {
                 "counters": self.counters.snapshot(),
                 "replicas": replicas,
                 "offline_groups": sorted(
@@ -894,6 +962,11 @@ class ReplicatedServer:
                     if d not in self._by_group
                 ),
             }
+            if self._gindex is not None:
+                # the fleet's routing view: how much of the replicas'
+                # trees the cluster index currently mirrors
+                out["global_index"] = self._gindex.stats()
+            return out
 
     # ------------------------------------------------ step profiler fan-out
 

@@ -76,7 +76,8 @@ import jax.numpy as jnp
 from ..obs.metrics import (
     ARENA_BYTES, ATTN_BACKEND, ATTN_BACKENDS, ATTN_BLOCKS_READ,
     CP_STREAM_SHARDS, DEFAULT_RATE_BUCKETS,
-    KV_BLOCKS_IN_USE, KV_BLOCKS_TOTAL, KV_HOST_TIER_BLOCKS, KV_WASTE_FRAC,
+    KV_BLOCKS_IN_USE, KV_BLOCKS_TOTAL, KV_DISK_TIER_BLOCKS,
+    KV_HOST_TIER_BLOCKS, KV_WASTE_FRAC,
     PREFILL_BLOCKS_READ, PREFIX_HIT_RATE, PREFIX_HIT_TOKENS, REGISTRY,
     record_shape_key, set_prefill_path,
 )
@@ -178,7 +179,7 @@ def _update_load_gauges() -> None:
 
     queued = active = 0
     kv_total = kv_used = kv_slots = kv_live = 0
-    host_blocks = hit_tok = elig_tok = 0
+    host_blocks = disk_blocks = hit_tok = elig_tok = 0
     backends = dict.fromkeys(ATTN_BACKENDS, 0)
     arena_bytes = dict.fromkeys(KV_DTYPES, 0)
     for s in list(_LIVE_SERVERS):
@@ -210,6 +211,7 @@ def _update_load_gauges() -> None:
             rad = getattr(s, "_radix", None)
             if rad is not None:
                 host_blocks += rad.host_blocks
+                disk_blocks += rad.disk_blocks
                 hit_tok += rad.hit_tokens
                 elig_tok += rad.eligible_tokens
     _M_QUEUE_DEPTH.set(queued)
@@ -221,6 +223,7 @@ def _update_load_gauges() -> None:
     KV_BLOCKS_TOTAL.set(kv_total)
     KV_BLOCKS_IN_USE.set(kv_used)
     KV_HOST_TIER_BLOCKS.set(host_blocks)
+    KV_DISK_TIER_BLOCKS.set(disk_blocks)
     PREFIX_HIT_RATE.set(hit_tok / elig_tok if elig_tok else 0.0)
     # shared prefix tokens count once per mapping row (mirror lengths are
     # prefix-inclusive) while their blocks are stored once — heavy sharing
@@ -916,6 +919,8 @@ class PipelineServer:
         paged_attn: str = "auto",
         prefix_cache: str = "off",
         host_pool_blocks: int = 0,
+        disk_pool_dir: Optional[str] = None,
+        disk_pool_blocks: int = 0,
         gauge_sweep_every_s: float = 0.0,
         cp: int = 1,
     ):
@@ -1107,11 +1112,14 @@ class PipelineServer:
         # pressure. "host": additionally demotes cold blocks to a pinned
         # host-RAM pool (device→host copy, streamed back bit-exact on a
         # later hit) before dropping — HBM becomes a cache level, not a
-        # hard ceiling. Explicit PrefixHandles remain the manual/pinned
-        # escape hatch and bypass the tree entirely.
-        if prefix_cache not in ("off", "hbm", "host"):
+        # hard ceiling. "disk": additionally spills cold host-pool nodes
+        # to memory-mapped files under a bounded on-disk pool that
+        # survives restarts (promoted disk→host→arena on a later hit).
+        # Explicit PrefixHandles remain the manual/pinned escape hatch
+        # and bypass the tree entirely.
+        if prefix_cache not in ("off", "hbm", "host", "disk"):
             raise ValueError(
-                f"prefix_cache must be off, hbm or host, got "
+                f"prefix_cache must be off, hbm, host or disk, got "
                 f"{prefix_cache!r}"
             )
         if prefix_cache != "off" and not self.paged:
@@ -1120,26 +1128,50 @@ class PipelineServer:
                 "kv_blocks): the cache shares refcounted arena blocks — "
                 "dense per-row reservations have nothing to share"
             )
-        if host_pool_blocks and prefix_cache != "host":
+        if host_pool_blocks and prefix_cache not in ("host", "disk"):
             raise ValueError(
                 "host_pool_blocks sizes the host-RAM tier — it needs "
-                f"prefix_cache='host' (got prefix_cache={prefix_cache!r})"
+                f"prefix_cache='host' or 'disk' (got "
+                f"prefix_cache={prefix_cache!r})"
             )
         if host_pool_blocks < 0:
             raise ValueError(
                 f"host_pool_blocks must be >= 0, got {host_pool_blocks}"
             )
-        if prefix_cache == "host" and jax.process_count() > 1:
+        if (disk_pool_dir or disk_pool_blocks) and prefix_cache != "disk":
             raise ValueError(
-                "prefix_cache='host' moves block KV through host numpy — "
-                "unsupported on multi-controller meshes; use 'hbm'"
+                "disk_pool_dir/disk_pool_blocks size the on-disk tier — "
+                f"they need prefix_cache='disk' (got "
+                f"prefix_cache={prefix_cache!r})"
+            )
+        if prefix_cache == "disk" and not disk_pool_dir:
+            raise ValueError(
+                "prefix_cache='disk' needs disk_pool_dir: the bounded "
+                "pool of memory-mapped entry files is the persistent "
+                "artifact cold nodes spill into"
+            )
+        if disk_pool_blocks < 0:
+            raise ValueError(
+                f"disk_pool_blocks must be >= 0, got {disk_pool_blocks}"
+            )
+        if prefix_cache in ("host", "disk") and jax.process_count() > 1:
+            raise ValueError(
+                f"prefix_cache={prefix_cache!r} moves block KV through "
+                "host numpy — unsupported on multi-controller meshes; "
+                "use 'hbm'"
             )
         self.prefix_cache = prefix_cache
         # host tier default: an arena-sized pool (the cache can spill
-        # everything it holds exactly once over)
+        # everything it holds exactly once over); the disk tier sits
+        # below it and defaults to another arena's worth on disk
         self.host_pool_blocks = (
-            int(host_pool_blocks) if prefix_cache != "host"
+            int(host_pool_blocks) if prefix_cache not in ("host", "disk")
             else int(host_pool_blocks or kv_blocks)
+        )
+        self.disk_pool_dir = disk_pool_dir if prefix_cache == "disk" else None
+        self.disk_pool_blocks = (
+            int(disk_pool_blocks or kv_blocks) if prefix_cache == "disk"
+            else 0
         )
         self._fault_plan = fault_plan
         if fault_retries < 0:
@@ -1325,8 +1357,8 @@ class PipelineServer:
                 self._alloc,
                 self.kv_block_size,
                 host_pool_blocks=(
-                    self.host_pool_blocks if self.prefix_cache == "host"
-                    else 0
+                    self.host_pool_blocks
+                    if self.prefix_cache in ("host", "disk") else 0
                 ),
                 read_kv=self._read_arena_blocks,
                 write_kv=self._write_arena_blocks,
@@ -1337,7 +1369,15 @@ class PipelineServer:
                 block_owner=(
                     self._alloc.owner if self.cp > 1 else None
                 ),
+                disk_pool_dir=self.disk_pool_dir,
+                disk_pool_blocks=self.disk_pool_blocks,
             )
+            if self.disk_pool_blocks:
+                # the pool is a persistent artifact: a fresh server
+                # re-indexes whatever entries the last process left
+                # behind (``restore`` replaces this tree with the
+                # snapshot's, which references the same entries)
+                self._radix.adopt_pool()
         else:
             self._radix = None
         # per-row pinned radix match (RadixRef) — released with the row's
@@ -1748,7 +1788,11 @@ class PipelineServer:
                 return d
 
             return {
-                # format 6: adds cp to serve_kwargs (the context-parallel
+                # format 7: disk-tier radix nodes ride as REFERENCES to
+                # their on-disk pool entries (meta "entry" key, no inlined
+                # KV arrays — the pool itself is the persistent artifact)
+                # and serve_kwargs gain disk_pool_dir/disk_pool_blocks.
+                # Format 6 added cp to serve_kwargs (the context-parallel
                 # shard count rides the checkpoint — snapshot-wins on
                 # restore, and a pre-cp reader's format gate refuses
                 # cleanly instead of silently rebuilding the arena
@@ -1762,7 +1806,7 @@ class PipelineServer:
                 # format 4 kv_dtype + the scale-arena/radix host-KV keys,
                 # format 3 the prefix-cache section; formats 1 (dense)
                 # through 5 still restore — see ``restore``
-                "format": 6,
+                "format": 7,
                 "radix": (
                     None if self._radix is None else self._radix.snapshot()
                 ),
@@ -1796,6 +1840,8 @@ class PipelineServer:
                     paged_attn=self.paged_attn,
                     prefix_cache=self.prefix_cache,
                     host_pool_blocks=self.host_pool_blocks,
+                    disk_pool_dir=self.disk_pool_dir,
+                    disk_pool_blocks=self.disk_pool_blocks,
                     # the cp shard count: restore refuses a mesh it cannot
                     # rebuild (cp×stages devices) rather than silently
                     # reshaping the arena
@@ -1836,7 +1882,7 @@ class PipelineServer:
         of an unsupported model family, raises the curated
         ``NotImplementedError`` instead of an obscure mesh/sharding error
         deep in the first dispatched program."""
-        if snap.get("format") not in (1, 2, 3, 4, 5, 6):
+        if snap.get("format") not in (1, 2, 3, 4, 5, 6, 7):
             raise ValueError(f"unknown snapshot format {snap.get('format')!r}")
         validate = getattr(engine, "_validate_serve", None)
         if validate is not None:
@@ -4103,7 +4149,18 @@ class PipelineServer:
                     self._radix.eligible_tokens += r.prompt_len
                     if spx_n:
                         self._radix.hit_tokens += spx_n
-                        PREFIX_HIT_TOKENS.inc(spx_n)
+                        # tier attribution: the take() that produced the
+                        # shared rplan recorded where each matched token
+                        # lived; co-admitted rows after the first reuse
+                        # blocks that are arena-resident by then
+                        tiers = (
+                            rplan.tier_tokens
+                            if rplan is not None and i == 0
+                            else {"hbm": spx_n}
+                        )
+                        for tier, tok in tiers.items():
+                            if tok:
+                                PREFIX_HIT_TOKENS.labels(tier=tier).inc(tok)
             if self.paged:
                 # tables must be on device BEFORE the admission dispatch —
                 # its scatter initializes exactly the blocks just mapped

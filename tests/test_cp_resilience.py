@@ -133,7 +133,7 @@ def stream_tally():
 @pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
 def test_cp_autosnapshot_kill_restore_token_exact(setup, tmp_path, kv_dtype):
     """THE cp durability gate: a cp=2 server auto-snapshots mid-decode
-    (format 6: serve_kwargs carry cp, the table planes and the sharded
+    (format 7: serve_kwargs carry cp, the table planes and the sharded
     allocator partition ride the per-row lists), the daemon dies, and a
     fresh server restored from disk finishes every in-flight request —
     greedy AND seeded-sampled — token-identically to the uninterrupted
@@ -153,7 +153,7 @@ def test_cp_autosnapshot_kill_restore_token_exact(setup, tmp_path, kv_dtype):
     srv.close()  # the "crash": the daemon dies between steps
 
     snap = load_snapshot(snap_dir)
-    assert snap["format"] == 6
+    assert snap["format"] == 7
     assert snap["serve_kwargs"]["cp"] == 2
     assert snap["serve_kwargs"]["kv_dtype"] == kv_dtype
     srv2 = PipelineServer.restore(eng, snap)

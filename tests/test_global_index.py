@@ -1,0 +1,355 @@
+"""Cluster-global radix index (ISSUE 20 tentpole b).
+
+The contract under test: replicas PUBLISH their radix tier transitions
+(insert → hbm, demote → host/disk, evict → removed) into one cluster
+map keyed by chained block hashes, and the fleet router / disagg
+planner consult that map BEFORE routing — one O(prompt blocks) lookup
+instead of N per-replica tree probes under N mutexes. The index is a
+routing hint, never a correctness surface: the routed replica's real
+tree governs admission, stale entries only cost a re-prefill, and
+``global_index=False`` restores the probe-free least-loaded baseline
+(the A/B leg the bench compares against).
+
+``PAGED_TEST_BLOCK_SIZE`` parameterizes the block size (CI reruns at 4
+under ``PAGED_FORCE_KERNEL=interpret``) and ``SHARDLINT_LOCK_ORDER=1``
+drives the chaos lane with lock-order assertions armed (router lock →
+replica mutex → ``cluster.index`` nesting).
+"""
+
+import http.client
+import json
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from llm_sharding_tpu.models import llama
+from llm_sharding_tpu.models.config import tiny_llama
+from llm_sharding_tpu.obs.metrics import GLOBAL_INDEX_ENTRIES, HANDOFF_BYTES
+from llm_sharding_tpu.runtime.disagg import DisaggServer
+from llm_sharding_tpu.runtime.engine import PipelineEngine
+from llm_sharding_tpu.runtime.generate import generate
+from llm_sharding_tpu.runtime.global_index import GlobalRadixIndex, TIER_WEIGHT
+from llm_sharding_tpu.runtime.ingress import IngressServer
+from llm_sharding_tpu.runtime.replicated import ReplicatedServer
+
+CFG = tiny_llama(num_hidden_layers=8)
+BS = int(os.environ.get("PAGED_TEST_BLOCK_SIZE", "8"))
+CAP = 128
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.key(17), dtype=jnp.float32)
+
+
+def make_rsrv(params, **kw):
+    kw.setdefault("prefix_cache", "hbm")
+    return ReplicatedServer(
+        CFG, params, data_parallel=2, num_stages=2,
+        devices=jax.devices()[:4], cache_dtype=jnp.float32,
+        capacity=CAP, kv_block_size=BS, kv_blocks=4 * CAP // BS + 1,
+        **kw,
+    )
+
+
+def oracle(params, p, n, **kw):
+    res = generate(CFG, params, p[None], n, cache_dtype=jnp.float32, **kw)
+    return [int(x) for x in res.tokens[0, len(p): int(res.lengths[0])]]
+
+
+def prompt(seed, n):
+    return np.random.default_rng(seed).integers(
+        1, CFG.vocab_size, n
+    ).astype(np.int32)
+
+
+def count_probes(rsrv):
+    """Shadow every replica's ``radix_match_tokens`` with a counting
+    wrapper — the legacy per-replica probe the index is meant to
+    replace on the routing path."""
+    calls = {"n": 0}
+    for s in rsrv.servers:
+        def probe(ids, _orig=s.radix_match_tokens):
+            calls["n"] += 1
+            return _orig(ids)
+        s.radix_match_tokens = probe
+    return calls
+
+
+# ------------------------------------------------------------ index units
+
+
+def test_unit_validation_and_subblock_noop():
+    with pytest.raises(ValueError, match="block_size"):
+        GlobalRadixIndex(0)
+    gx = GlobalRadixIndex(4)
+    gx.publish("a", [1, 2, 3], "hbm")  # sub-block tail: never indexed
+    assert gx.entries() == 0 and gx.published == 0
+    # a lookup that can't even form one block is a structural miss —
+    # it must not touch the counters (no lock taken)
+    assert gx.best([1, 2]) is None
+    assert gx.scores([1, 2], ["a"]) == {"a": (0, 0)}
+    assert gx.lookups == 0
+
+
+def test_unit_depth_then_tier_scoring():
+    gx = GlobalRadixIndex(4)
+    ids = [5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16]  # 3 blocks
+    gx.publish("a", ids, "host")
+    gx.publish("b", ids[:8], "hbm")
+    # deeper beats warmer: a's host entry at 3 blocks outranks b's
+    # hbm entry at 2
+    assert gx.best(ids) == ("a", "host", 12)
+    assert gx.scores(ids + [99], ["a", "b", "c"]) == {
+        "a": (12, TIER_WEIGHT["host"]),
+        "b": (8, TIER_WEIGHT["hbm"]),
+        "c": (0, 0),
+    }
+    # equal depth: the warmer tier wins the tie
+    gx.publish("b", ids, "disk")
+    assert gx.best(ids) == ("a", "host", 12)
+    gx.publish("b", ids, "hbm")  # tier upsert, not a second entry
+    assert gx.best(ids) == ("b", "hbm", 12)
+    # exclude skips the winner (the cross-fill source hunt)
+    assert gx.best(ids, exclude=("b",)) == ("a", "host", 12)
+    assert gx.best(ids, exclude=("a", "b")) is None
+    # cold fleet for an unrelated prompt
+    assert gx.best([77] * 12) is None
+
+
+def test_unit_chained_hashes_bind_the_whole_prefix():
+    gx = GlobalRadixIndex(4)
+    A, B, X = [1, 2, 3, 4], [5, 6, 7, 8], [9, 9, 9, 9]
+    gx.publish("a", A + B, "hbm")
+    # entries sit at NODE boundaries only: the A+B publish says nothing
+    # about the bare-A prefix until some node publishes at that depth
+    assert gx.scores(A + B, ["a"]) == {"a": (8, 3)}
+    assert gx.scores(A + X, ["a"]) == {"a": (0, 0)}
+    gx.publish("a", A, "hbm")
+    assert gx.scores(A + X, ["a"]) == {"a": (4, 3)}
+    # chaining binds position: the same second block under a different
+    # first block hashes to a different key
+    assert gx.scores(X + B, ["a"]) == {"a": (0, 0)}
+    # two replicas holding the same tokens share one hash bucket
+    gx.publish("b", A, "disk")
+    assert gx.best(A) == ("a", "hbm", 4)
+    assert gx.best(A, exclude=("a",)) == ("b", "disk", 4)
+
+
+def test_unit_removal_drop_replica_and_stats():
+    gx = GlobalRadixIndex(4)
+    A, B = [1, 2, 3, 4], [5, 6, 7, 8]
+    gx.publish("a", A, "hbm")
+    gx.publish("a", A + B, "hbm")
+    gx.publish("b", A, "host")
+    assert gx.entries() == 3
+    assert GLOBAL_INDEX_ENTRIES.value == 3
+    # tier=None removes exactly one replica's entry at that depth
+    gx.publish("a", A, None)
+    assert gx.entries() == 2
+    gx.publish("a", A, None)  # double-remove is a no-op
+    st = gx.stats()
+    assert st["published"] == 3 and st["removed"] == 1
+    assert st["replicas"] == ["a", "b"]
+    # a miss counts a lookup but not a hit
+    lk, lh = gx.lookups, gx.lookup_hits
+    assert gx.best([7, 7, 7, 7]) is None
+    assert gx.scores([7, 7, 7, 7], ["a"]) == {"a": (0, 0)}
+    assert (gx.lookups, gx.lookup_hits) == (lk + 2, lh)
+    # a retiring replica's entries all go at once
+    assert gx.drop_replica("a") == 1  # only A+B was still live
+    assert gx.drop_replica("a") == 0
+    assert gx.entries() == 1 and gx.stats()["replicas"] == ["b"]
+    assert GLOBAL_INDEX_ENTRIES.value == 1
+
+
+# -------------------------------------------------------- dp2 fleet e2e
+
+
+def test_dp2_index_routes_to_warm_replica(params):
+    """ACCEPTANCE: with the index live, a shared-prefix submit lands on
+    the replica that published the prefix — chosen from ONE index
+    lookup, zero per-replica tree probes on the routing path."""
+    rsrv = make_rsrv(params)
+    try:
+        assert rsrv._gindex is not None  # auto-wired for caching replicas
+        warm = rsrv._by_group[1]  # NOT the round-robin favourite
+        p1 = prompt(201, 3 * BS + 1)
+        r1 = warm.submit(p1, 4)
+        rsrv.run_until_idle()
+        assert r1.error is None
+        assert rsrv._gindex.entries() > 0  # release-time insert published
+        st0 = rsrv._gindex.stats()
+        probes = count_probes(rsrv)
+        p2 = np.concatenate([p1, prompt(202, 3)])
+        hit0 = warm._radix.hit_tokens
+        r2 = rsrv.submit(p2, 4)
+        assert rsrv._owner[r2] is warm
+        assert probes["n"] == 0  # the index replaced per-replica probing
+        rsrv.run_until_idle()
+        assert r2.error is None
+        assert r2.tokens == oracle(params, p2, 4)
+        assert warm._radix.hit_tokens - hit0 >= 3 * BS
+        st1 = rsrv._gindex.stats()
+        assert st1["lookups"] > st0["lookups"]
+        assert st1["lookup_hits"] > st0["lookup_hits"]
+        # the operator surface mirrors the same counters
+        assert rsrv.stats()["global_index"]["entries"] >= 1
+    finally:
+        rsrv.close()
+
+
+def test_dp2_tier_transitions_ride_the_index(params):
+    """Demotion republishes the entry at its colder tier, promotion
+    lifts it back to hbm, and eviction removes it — the index tracks
+    the tree through the whole ladder."""
+    rsrv = make_rsrv(
+        params, prefix_cache="host", host_pool_blocks=4 * CAP // BS,
+    )
+    try:
+        gx = rsrv._gindex
+        warm = rsrv._by_group[0]
+        p1 = prompt(211, 3 * BS + 1)
+        r1 = warm.submit(p1, 4)
+        rsrv.run_until_idle()
+        assert r1.error is None
+        assert gx.best(p1) == ("g0", "hbm", 3 * BS)
+        with warm._mutex:
+            warm._radix.demote_all()
+        assert gx.best(p1) == ("g0", "host", 3 * BS)
+        # a routed resubmit still steers to the warm replica (host tier
+        # outranks a cold peer) and promotes host → arena
+        r2 = rsrv.submit(p1, 4)
+        assert rsrv._owner[r2] is warm
+        rsrv.run_until_idle()
+        assert r2.error is None
+        assert r2.tokens == oracle(params, p1, 4)
+        assert gx.best(p1) == ("g0", "hbm", 3 * BS)  # promotion republished
+        with warm._mutex:
+            warm._radix.drop_all()
+        assert gx.best(p1) is None  # eviction published the removal
+        assert gx.entries() == 0
+    finally:
+        rsrv.close()
+
+
+def test_dp2_global_index_false_disables_index_and_probe(params):
+    """``global_index=False`` is the A/B baseline: no index is built,
+    no publish hook is wired, and the router never probes a tree —
+    pure health-aware least-loaded routing."""
+    rsrv = make_rsrv(params, global_index=False)
+    try:
+        assert rsrv._gindex is None
+        warm = rsrv._by_group[1]
+        p1 = prompt(221, 3 * BS + 1)
+        r1 = warm.submit(p1, 4)
+        rsrv.run_until_idle()
+        assert r1.error is None
+        assert warm._radix.publish is None  # hook never wired
+        probes = count_probes(rsrv)
+        p2 = np.concatenate([p1, prompt(222, 3)])
+        r2 = rsrv.submit(p2, 4)
+        assert probes["n"] == 0  # probing disabled along with the index
+        rsrv.run_until_idle()
+        assert r2.error is None
+        assert r2.tokens == oracle(params, p2, 4)
+        assert "global_index" not in rsrv.stats()
+    finally:
+        rsrv.close()
+
+
+# ----------------------------------------------------- disagg cross-fill
+
+
+def test_disagg_cross_fill_sources_from_index(params):
+    """The cross-replica fill finds its source from ONE index lookup
+    (deepest match, warmest tier, routed dst excluded) instead of
+    probing every peer — and the stream still lands token-identical."""
+    dsrv = DisaggServer(
+        CFG, params, data_parallel=2, num_stages=2,
+        devices=jax.devices()[:4], cache_dtype=jnp.float32,
+        capacity=64, kv_block_size=BS, kv_blocks=6 * 64 // BS + 1,
+        prefix_cache="hbm", roles=["prefill", "decode"],
+    )
+    try:
+        assert dsrv._gindex is not None
+        pa = prompt(71, 2 * BS)
+        r = dsrv.submit(pa, 4)
+        dsrv.run_until_idle()
+        assert r.error is None
+        # drop the PREFILL replica's cache: its removals publish, so the
+        # index now names only the decode side as a source
+        pre = [s for s in dsrv.servers if dsrv.role_of(s) == "prefill"][0]
+        pre_key = f"g{dsrv._group_of[pre]}"
+        with pre._mutex:
+            pre._radix.drop_all()
+        assert pre.radix_match_tokens(pa) == 0
+        hit = dsrv._gindex.best(pa, exclude=(pre_key,))
+        assert hit is not None and hit[0] != pre_key and hit[2] >= 2 * BS
+        bytes0 = HANDOFF_BYTES.value
+        hit0 = pre._radix.hit_tokens
+        lk0 = dsrv._gindex.stats()["lookups"]
+        p2 = np.concatenate([pa, prompt(72, 3)])
+        r2 = dsrv.submit(p2, 4)
+        dsrv.run_until_idle()
+        assert r2.error is None
+        assert r2.tokens == oracle(params, p2, 4)
+        assert HANDOFF_BYTES.value > bytes0  # streamed, not re-prefilled
+        assert pre._radix.hit_tokens - hit0 >= 2 * BS
+        assert dsrv._gindex.stats()["lookups"] > lk0
+    finally:
+        dsrv.close()
+
+
+# ------------------------------------------------------- /indexz surface
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60.0)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        data = resp.read()
+        return resp.status, (json.loads(data) if data else None)
+    finally:
+        conn.close()
+
+
+def test_indexz_endpoint(params):
+    """/indexz serves the cluster map's stats on an indexed fleet and a
+    clean 404 on a backend with no index."""
+    rsrv = make_rsrv(params)
+    try:
+        r1 = rsrv._by_group[0].submit(prompt(231, 2 * BS + 1), 4)
+        rsrv.run_until_idle()
+        assert r1.error is None
+        ing = IngressServer(rsrv, poll_interval_s=0.0005)
+        ing.start()
+        try:
+            status, body = _get(ing.port, "/indexz")
+            assert status == 200
+            assert body["entries"] >= 1 and body["replicas"] == ["g0"]
+            assert body["published"] >= 1
+        finally:
+            ing.stop()
+    finally:
+        rsrv.close()
+    eng = PipelineEngine(
+        CFG, params, num_stages=2, devices=jax.devices()[:2],
+        cache_dtype=jnp.float32,
+    )
+    srv = eng.serve(capacity=8, kv_block_size=BS, kv_blocks=33)
+    try:
+        ing = IngressServer(srv, poll_interval_s=0.0005)
+        ing.start()
+        try:
+            status, body = _get(ing.port, "/indexz")
+            assert status == 404
+            assert body["error"]["type"] == "no_index"
+        finally:
+            ing.stop()
+    finally:
+        srv.close()

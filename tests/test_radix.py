@@ -207,6 +207,43 @@ def test_unit_insert_through_host_node_keeps_block_cursor():
     c.check(), a.check()
 
 
+def test_unit_insert_splits_pinned_edge_at_block_boundary():
+    """ISSUE-20 satellite 1: an insert whose tokens diverge at a block
+    boundary INSIDE a PINNED edge must split the edge and attach its tail
+    (regression: the refs>0 guard made insert bail, so a prompt released
+    while a sibling decode held the edge was silently never indexed).
+    Safety of the split under a live pin: the original node object becomes
+    the BOTTOM half and keeps the refs, so the pinned RadixRef still
+    resolves; the refs-0 top half cannot be evicted out from under it
+    because eviction requires a COLD whole subtree."""
+    store, rd, wr, fill = _fake_store()
+    a = BlockAllocator(64, BS)
+    c = RadixCache(a, BS, host_pool_blocks=16, read_kv=rd, write_kv=wr)
+    ids = np.arange(0, 3 * BS, dtype=np.int32)
+    b = a.alloc(3)
+    fill(b)
+    c.insert(ids, b)
+    ref = c.take(ids, 3 * BS)  # the in-flight sibling's pin
+    assert ref is not None and ref.n == 3 * BS
+    ids2 = ids.copy()
+    ids2[2 * BS] = 7  # diverge exactly at the block-2 boundary
+    b2 = a.alloc(3)
+    fill(b2)
+    consumed = c.insert(ids2, b2)
+    assert consumed == {b2[2]}, consumed  # tail attached despite the pin
+    a.free(b2[:2])
+    c.check(), a.check()
+    assert c.match_tokens(ids2) == 3 * BS
+    assert c.match_tokens(ids) == 3 * BS
+    c.release(ref)  # the pinned path survived the split intact
+    c.check(), a.check()
+    ref2 = c.take(ids2, 3 * BS)
+    assert ref2 is not None and ref2.n == 3 * BS
+    assert ref2.blocks[-1] == b2[2]
+    c.release(ref2)
+    c.check(), a.check()
+
+
 def test_unit_host_pool_cap_drops_lru():
     store, rd, wr, fill = _fake_store()
     a = BlockAllocator(64, BS)
@@ -335,6 +372,96 @@ def test_coadmit_rejects_layout_overflow_request(setup):
     assert list(ra.tokens) == oracle(params, p, 2)
     assert list(rb.tokens) == oracle(params, p, 4 * BS)
     check_clean(srv)
+
+
+def _divergent_tail(p, at, seed):
+    """A BS-token tail whose first token provably differs from ``p[at]``
+    (rng collisions would silently turn the mid-edge divergence this
+    exercises into a deeper match)."""
+    tail = prompt(seed, BS)
+    if tail[0] == p[at]:
+        tail[0] = 1 + int(tail[0]) % (CFG.vocab_size - 1)
+    return tail
+
+
+def test_coadmit_release_splits_pinned_sibling_edge(setup):
+    """ISSUE-20 satellite 1, end to end: rB shares two blocks with a long
+    cached edge, diverges at the block boundary, and finishes while rA is
+    still decoding over that edge (pinning it). rB's release-time insert
+    must split the pinned edge and index rB's prompt — a later identical
+    prompt is a warm hit, token-identically."""
+    params, eng = setup
+    srv = radix_serve(eng)
+    p4 = prompt(100, 4 * BS)
+    r0 = srv.submit(p4, 2)
+    srv.run_until_idle()
+    assert list(r0.tokens) == oracle(params, p4, 2)
+    # rA hits the 4-block edge and keeps decoding: the edge stays pinned
+    pa = np.concatenate([p4, prompt(101, 3)])
+    ra = srv.submit(pa, 40)
+    srv.step()
+    assert ra.row is not None and not ra.done
+    pb = np.concatenate([p4[: 2 * BS], _divergent_tail(p4, 2 * BS, 102)])
+    rb = srv.submit(pb, 2)
+    while not rb.done:
+        srv.step()
+    assert not ra.done  # the pin was live at rb's release
+    assert list(rb.tokens) == oracle(params, pb, 2)
+    # the regression: without the split, only the 2 shared blocks matched
+    assert srv._radix.match_tokens(pb) == 3 * BS
+    srv.run_until_idle()
+    assert list(ra.tokens) == oracle(params, pa, 40)
+    hits0 = srv.prefix_cache_stats()["hit_tokens"]
+    pc = np.concatenate([pb, prompt(103, 3)])
+    rc = srv.submit(pc, 3)
+    srv.run_until_idle()
+    assert list(rc.tokens) == oracle(params, pc, 3)
+    assert srv.prefix_cache_stats()["hit_tokens"] == hits0 + 3 * BS
+    check_clean(srv)
+
+
+def test_coadmit_release_splits_pinned_sibling_edge_cp2(setup):
+    """The same release-time split with cp=2: the divergent sibling's
+    insert under context parallelism carries per-shard block rows and
+    host_owners tags through the split path; greedy output stays
+    token-identical to the unsharded oracle."""
+    params, eng = setup
+    if len(jax.devices()) < 8:
+        pytest.skip("cp=2 x 4 stages needs 8 devices")
+    srv = eng.serve(
+        capacity=CAP, kv_block_size=BS, kv_blocks=4 * CAP // BS + 1,
+        prefix_cache="hbm", prefill_chunk=2 * BS, cp=2,
+    )
+    p4 = prompt(110, 4 * BS)
+    r0 = srv.submit(p4, 2)
+    srv.run_until_idle()
+    assert list(r0.tokens) == oracle(params, p4, 2)
+    pa = np.concatenate([p4, prompt(111, 3)])
+    ra = srv.submit(pa, 40)
+    srv.step()
+    assert not ra.done
+    # two divergent blocks: chunk-admitted rows index the plen-1 floor,
+    # so a 1-block tail would fall entirely under the cap
+    tail = np.concatenate(
+        [_divergent_tail(p4, 2 * BS, 112), prompt(114, BS)]
+    )
+    pb = np.concatenate([p4[: 2 * BS], tail])
+    rb = srv.submit(pb, 2)
+    while not rb.done:
+        srv.step()
+    assert not ra.done
+    assert list(rb.tokens) == oracle(params, pb, 2)
+    assert srv._radix.match_tokens(pb) == 3 * BS
+    srv.run_until_idle()
+    assert list(ra.tokens) == oracle(params, pa, 40)
+    hits0 = srv._radix.hit_tokens
+    pc = np.concatenate([pb, prompt(113, 3)])
+    rc = srv.submit(pc, 3)
+    srv.run_until_idle()
+    assert list(rc.tokens) == oracle(params, pc, 3)
+    assert srv._radix.hit_tokens > hits0
+    check_clean(srv)
+    srv.close()
 
 
 def test_explicit_handle_bypasses_tree(setup):
@@ -528,7 +655,7 @@ def test_snapshot_restore_preserves_tree_and_rows(setup, tmp_path):
         srv.step()
     assert r2.row is not None and not r2.done
     snap = srv.snapshot()
-    assert snap["format"] == 6 and snap["radix"] is not None
+    assert snap["format"] == 7 and snap["radix"] is not None
     d = str(tmp_path / "snap")
     save_snapshot(snap, d)
     srv2 = PipelineServer.restore(eng, load_snapshot(d))
@@ -644,13 +771,15 @@ def test_metrics_hit_rate_host_tier_and_waste(setup):
     _update_load_gauges()
     # idle warm cache: blocks are held by the tree alone → zero waste
     assert KV_WASTE_FRAC.value == 0.0
-    base = PREFIX_HIT_TOKENS.value
+    # hit tokens are attributed per TIER the bytes were found in (ISSUE 20);
+    # this hit is device-resident, so it lands on the hbm label
+    base = PREFIX_HIT_TOKENS.labels(tier="hbm").value
     r = srv.submit(np.concatenate([p1, prompt(71, 3)]), 4)
     srv.run_until_idle()
     assert list(r.tokens) == oracle(
         params, np.concatenate([p1, prompt(71, 3)]), 4
     )
-    assert PREFIX_HIT_TOKENS.value - base == 2 * BS
+    assert PREFIX_HIT_TOKENS.labels(tier="hbm").value - base == 2 * BS
     assert PREFIX_HIT_RATE.value > 0
     srv._radix.demote_all()
     _update_load_gauges()

@@ -211,7 +211,7 @@ def test_paged_snapshot_restore_mid_decode_token_exact(setup, tmp_path):
     for _ in range(4):
         srv.step()
     snap = srv.snapshot()
-    assert snap["format"] == 6 and snap["paged"] is not None
+    assert snap["format"] == 7 and snap["paged"] is not None
     import tempfile
 
     d = tempfile.mkdtemp(dir=tmp_path)
